@@ -1,0 +1,189 @@
+"""The micro-batch: the unit of data flow in the whole framework.
+
+The reference moves ONE heap-allocated tuple at a time between operator threads
+(``new tuple_t()`` per emitted tuple, ``wf/source.hpp:184``, ``wf/shipper.hpp:87``) and
+only its GPU operators batch (``wf/win_seq_gpu.hpp:352-560``). On TPU the only winning
+model is micro-batch-at-a-time with structure-of-arrays buffers, so the *stream itself*
+is a sequence of fixed-capacity :class:`Batch` values:
+
+- ``key``/``id``/``ts`` are the reference's tuple control-field contract
+  ``getControlFields() -> (key, id, ts)`` (``wf/window.hpp:132``,
+  ``src/graph_test/graph_common.hpp:69-80``) lifted to arrays.
+- ``payload`` is an arbitrary pytree of ``[C, ...]`` arrays — the user tuple fields.
+- ``valid`` is the occupancy mask: fixed capacity + mask is how every dynamic-shape
+  problem (filtering, flatmap fan-out, partial flush at EOS) is made XLA-static.
+
+A :class:`Batch` is a JAX pytree, so it flows through ``jit``/``vmap``/``shard_map``
+unchanged; sharding the leading (capacity) axis over a mesh is the data-parallel
+replication of the reference (every operator's ``parallelism`` replicas,
+``wf/source.hpp:284-296``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: dtype used for the (key, id, ts) control fields. int32: TPU-native word size; per-key
+#: ids and relative-usecs timestamps fit comfortably for streaming benchmarks.
+CTRL_DTYPE = jnp.int32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """Fixed-capacity SoA micro-batch of tuples.
+
+    All leaves share the leading capacity axis ``C``. Lanes where ``valid`` is False
+    are padding: operators must ignore them and must produce masked-out garbage only
+    in invalid lanes.
+    """
+
+    key: jax.Array       # i32[C] — key slot in [0, max_keys)
+    id: jax.Array        # i32[C] — per-key progressive id (control field "id")
+    ts: jax.Array        # i32[C] — timestamp (control field "ts")
+    payload: Any         # pytree of [C, ...] arrays
+    valid: jax.Array     # bool[C]
+
+    # -- introspection ----------------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self.key.shape[0]
+
+    def count(self) -> jax.Array:
+        """Number of live tuples (traced scalar)."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    # -- construction -----------------------------------------------------------------
+
+    @staticmethod
+    def empty(capacity: int, payload_spec: Any) -> "Batch":
+        """An all-invalid batch. ``payload_spec`` is a pytree of
+        ``jax.ShapeDtypeStruct`` (without the capacity axis) or example arrays."""
+        def mk(leaf):
+            shape = getattr(leaf, "shape", ())
+            dtype = getattr(leaf, "dtype", jnp.float32)
+            return jnp.zeros((capacity,) + tuple(shape), dtype)
+        return Batch(
+            key=jnp.zeros((capacity,), CTRL_DTYPE),
+            id=jnp.zeros((capacity,), CTRL_DTYPE),
+            ts=jnp.zeros((capacity,), CTRL_DTYPE),
+            payload=jax.tree.map(mk, payload_spec),
+            valid=jnp.zeros((capacity,), jnp.bool_),
+        )
+
+    @staticmethod
+    def of(payload: Any, key=None, id=None, ts=None, valid=None) -> "Batch":
+        """Build a batch from payload arrays (host or device)."""
+        leaves = jax.tree.leaves(payload)
+        if not leaves:
+            raise ValueError("payload must contain at least one array")
+        c = np.shape(leaves[0])[0]
+        z = jnp.zeros((c,), CTRL_DTYPE)
+        return Batch(
+            key=z if key is None else jnp.asarray(key, CTRL_DTYPE),
+            id=z if id is None else jnp.asarray(id, CTRL_DTYPE),
+            ts=z if ts is None else jnp.asarray(ts, CTRL_DTYPE),
+            payload=jax.tree.map(jnp.asarray, payload),
+            valid=jnp.ones((c,), jnp.bool_) if valid is None else jnp.asarray(valid, jnp.bool_),
+        )
+
+    # -- transforms -------------------------------------------------------------------
+
+    def replace(self, **kw) -> "Batch":
+        return dataclasses.replace(self, **kw)
+
+    def with_payload(self, payload: Any) -> "Batch":
+        return dataclasses.replace(self, payload=payload)
+
+    def mask(self, keep: jax.Array) -> "Batch":
+        """Intersect the validity mask with ``keep`` (the Filter primitive)."""
+        return dataclasses.replace(self, valid=self.valid & keep)
+
+    def compact(self) -> "Batch":
+        """Pack live tuples to the front (stable). Counterpart of the reference GPU
+        emitter's prescan + ``create_sub_batch`` compaction
+        (``wf/standard_nodes_gpu.hpp:52-238``, scan suite ``wf/gpu_utils.hpp:330-417``).
+
+        Invalid lanes are moved to the tail and zero-masked. Shape is unchanged."""
+        c = self.capacity
+        # stable partition: sort by (!valid, position)
+        order = jnp.argsort(jnp.where(self.valid, 0, 1), stable=True)
+        take = lambda a: jnp.take(a, order, axis=0)
+        return Batch(
+            key=take(self.key), id=take(self.id), ts=take(self.ts),
+            payload=jax.tree.map(take, self.payload),
+            valid=take(self.valid),
+        )
+
+    def select(self, idx: jax.Array, valid: jax.Array) -> "Batch":
+        """Gather lanes ``idx`` with a new validity mask (size may differ)."""
+        take = lambda a: jnp.take(a, idx, axis=0)
+        return Batch(
+            key=take(self.key), id=take(self.id), ts=take(self.ts),
+            payload=jax.tree.map(take, self.payload),
+            valid=valid & take(self.valid),
+        )
+
+    def sorted_by(self, *, by: str = "ts") -> "Batch":
+        """Stable sort live tuples by ``ts`` or ``id`` (invalid lanes to the tail).
+        The batch-level counterpart of the reference ``Ordering_Node``
+        (``wf/ordering_node.hpp:124-280``): DETERMINISTIC-mode order restoration."""
+        k = self.ts if by == "ts" else self.id
+        big = jnp.iinfo(CTRL_DTYPE).max
+        order = jnp.argsort(jnp.where(self.valid, k, big), stable=True)
+        take = lambda a: jnp.take(a, order, axis=0)
+        return Batch(
+            key=take(self.key), id=take(self.id), ts=take(self.ts),
+            payload=jax.tree.map(take, self.payload),
+            valid=take(self.valid),
+        )
+
+    # -- host side --------------------------------------------------------------------
+
+    def to_host(self) -> "Batch":
+        return jax.tree.map(np.asarray, self)
+
+    def live_payload(self) -> Any:
+        """Host-side: payload restricted to live lanes (numpy)."""
+        v = np.asarray(self.valid)
+        return jax.tree.map(lambda a: np.asarray(a)[v], self.payload)
+
+
+def concat_batches(a: Batch, b: Batch) -> Batch:
+    """Concatenate two batches along the capacity axis (merge primitive)."""
+    cat = lambda x, y: jnp.concatenate([x, y], axis=0)
+    return Batch(
+        key=cat(a.key, b.key), id=cat(a.id, b.id), ts=cat(a.ts, b.ts),
+        payload=jax.tree.map(cat, a.payload, b.payload),
+        valid=cat(a.valid, b.valid),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class TupleRef:
+    """Per-tuple view handed to user functions under ``vmap`` — the counterpart of the
+    reference passing ``tuple_t&`` into the user lambda. ``key``/``id``/``ts`` are the
+    control fields; payload fields are reachable as attributes (dict payloads) or via
+    ``.data`` (any pytree)."""
+
+    key: jax.Array
+    id: jax.Array
+    ts: jax.Array
+    data: Any
+
+    def __getattr__(self, name):
+        data = object.__getattribute__(self, "data")
+        if isinstance(data, dict) and name in data:
+            return data[name]
+        raise AttributeError(name)
+
+
+def tuple_refs(batch: Batch) -> TupleRef:
+    """Batched TupleRef (each field keeps its capacity axis; vmap strips it)."""
+    return TupleRef(key=batch.key, id=batch.id, ts=batch.ts, data=batch.payload)

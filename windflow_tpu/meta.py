@@ -1,0 +1,106 @@
+"""Signature introspection for user functions.
+
+The reference deduces ``tuple_t``/``result_t`` and the function *flavour* (plain/rich,
+in-place/non-in-place, itemized/loop) from ``&F_t::operator()`` by template
+metaprogramming (``wf/meta.hpp:49-877``, ``wf/meta_gpu.hpp``, catalogue in
+``/root/reference/API``). The Python counterpart inspects ``inspect.signature`` to
+classify the callable once at operator-construction time, so builders can reject
+ill-formed functions *at graph-build time* with an explicit list of accepted
+signatures — mirroring the reference's static_assert messages
+(``wf/builders.hpp:56-58``).
+
+Accepted signatures (per-tuple functions run under ``vmap``; ``t`` is a
+:class:`~windflow_tpu.batch.TupleRef`):
+
+- Source   : ``f(i, ctx?) -> payload``            (itemized; ``i`` = global index array)
+- Map      : ``f(t, ctx?) -> payload``            (non-in-place; key/id/ts preserved)
+- Filter   : ``f(t, ctx?) -> bool``
+- FlatMap  : ``f(t, shipper, ctx?) -> None``      (push-style, static max fan-out)
+- Accumulator: ``f(acc, t, ctx?) -> acc``
+- Window (non-incremental): ``f(wid, iterable, ctx?) -> result``
+- Window (incremental)    : ``f(wid, t, acc, ctx?) -> acc``
+- Combine (associative)   : ``f(a, b) -> c``
+- Sink     : ``f(payload_dict_of_numpy, ctx?) -> None``  (host-side, per live batch)
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable
+
+RICH_PARAM_NAMES = ("ctx", "context", "rc")
+
+
+class SignatureError(TypeError):
+    """Raised at graph-build time when a user callable has an unusable signature."""
+
+
+def _positional_params(fn: Callable):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    return [p for p in sig.parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+
+
+def classify(fn: Callable, *, base_arity: int, what: str, accepted: str):
+    """Return ``is_rich`` for a user callable expected to take ``base_arity``
+    positional args, optionally followed by a RuntimeContext parameter.
+
+    Counterpart of the per-operator ``get_tuple_t_X`` overload families
+    (``wf/meta.hpp:49-88`` for Source, etc.)."""
+    params = _positional_params(fn)
+    if params is None:
+        # builtins / jitted callables without signatures: assume plain
+        return False
+    n = len(params)
+    if n == base_arity:
+        return False
+    if n == base_arity + 1:
+        return True
+    raise SignatureError(
+        f"{what}: callable takes {n} positional parameters; accepted signatures are:\n"
+        f"  {accepted}\n"
+        f"(append a trailing context parameter named one of {RICH_PARAM_NAMES} for the"
+        f" rich variant — wf/meta.hpp semantics)")
+
+
+def classify_source(fn):
+    return classify(fn, base_arity=1, what="Source",
+                    accepted="f(i) -> payload | f(i, ctx) -> payload")
+
+
+def classify_map(fn):
+    return classify(fn, base_arity=1, what="Map",
+                    accepted="f(t) -> payload | f(t, ctx) -> payload")
+
+
+def classify_filter(fn):
+    return classify(fn, base_arity=1, what="Filter",
+                    accepted="f(t) -> bool | f(t, ctx) -> bool")
+
+
+def classify_flatmap(fn):
+    return classify(fn, base_arity=2, what="FlatMap",
+                    accepted="f(t, shipper) | f(t, shipper, ctx)")
+
+
+def classify_accumulator(fn):
+    return classify(fn, base_arity=2, what="Accumulator",
+                    accepted="f(acc, t) -> acc | f(acc, t, ctx) -> acc")
+
+
+def classify_window(fn):
+    return classify(fn, base_arity=2, what="Window function",
+                    accepted="f(wid, iterable) -> result | f(wid, iterable, ctx) -> result")
+
+
+def classify_winupdate(fn):
+    return classify(fn, base_arity=3, what="Incremental window function",
+                    accepted="f(wid, t, acc) -> acc | f(wid, t, acc, ctx) -> acc")
+
+
+def classify_sink(fn):
+    return classify(fn, base_arity=1, what="Sink",
+                    accepted="f(batch) | f(batch, ctx)")

@@ -1,0 +1,81 @@
+"""Operator base class — uniform introspection over all operators.
+
+Counterpart of ``Basic_Operator`` (``wf/basic_operator.hpp:47-79``): ``getName``,
+``getParallelism``, ``getRoutingMode``, ``isUsed``, ``get_StatsRecords``. Here an
+operator is additionally a *pure batch transform*: ``apply(state, batch) -> (state,
+out_batch)`` traced into the enclosing compiled program. Chained operators therefore
+fuse into one XLA program — the always-on analogue of the reference's ``ff_comb``
+chaining (``wf/pipegraph.hpp:1272-1318``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from ..basic import routing_modes_t
+from ..batch import Batch
+from ..runtime.stats import Stats_Record
+
+
+class Basic_Operator:
+    """Base of all operators.
+
+    Lifecycle of the device-side state (the replacement for per-replica C++ member
+    state): ``init_state(payload_spec)`` builds the state pytree; ``apply`` threads it
+    through each micro-batch; ``flush`` drains residual state at EOS (the reference's
+    ``eosnotify`` paths, e.g. ``wf/win_seq.hpp:468-529``)."""
+
+    #: set by subclasses
+    routing: routing_modes_t = routing_modes_t.FORWARD
+
+    def __init__(self, name: str, parallelism: int = 1):
+        self._name = name
+        self._parallelism = max(1, int(parallelism))
+        self._used = False
+        self._stats = [Stats_Record(name, i) for i in range(self._parallelism)]
+
+    # -- Basic_Operator surface (wf/basic_operator.hpp:47-79) -------------------------
+
+    def getName(self) -> str:
+        return self._name
+
+    def getParallelism(self) -> int:
+        return self._parallelism
+
+    def getRoutingMode(self) -> routing_modes_t:
+        return self.routing
+
+    def isUsed(self) -> bool:
+        return self._used
+
+    def get_StatsRecords(self):
+        return list(self._stats)
+
+    # pythonic aliases
+    name = property(getName)
+    parallelism = property(getParallelism)
+
+    # -- batch-transform surface ------------------------------------------------------
+
+    def init_state(self, payload_spec: Any) -> Any:
+        """Device state pytree for this operator (None if stateless)."""
+        return None
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        """Output payload spec given the input payload spec (type propagation — the
+        analogue of the reference's typeid check at add/chain time,
+        ``wf/pipegraph.hpp:1573-1578``)."""
+        return payload_spec
+
+    def apply(self, state: Any, batch: Batch) -> Tuple[Any, Batch]:
+        raise NotImplementedError
+
+    def flush(self, state: Any) -> Tuple[Any, Optional[Batch]]:
+        """Drain residual state at EOS. Returns (state, out_batch or None)."""
+        return state, None
+
+    def _mark_used(self):
+        self._used = True
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._name!r}, parallelism={self._parallelism})"

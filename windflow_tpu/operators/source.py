@@ -1,0 +1,131 @@
+"""Source — stream generation.
+
+Counterpart of ``wf/source.hpp`` (``Source_Node::svc`` at ``:168-237``): the reference
+supports an *itemized* signature ``bool(tuple&)`` (fill one tuple, return false at EOS)
+and a *loop* signature ``bool(Shipper&)``, plus rich variants. Here a source produces
+whole micro-batches; three flavours:
+
+- ``GeneratorSource``: wraps a host Python generator yielding payload pytrees (numpy) —
+  the general case; batches are device_put on the fly (async, double-buffered by JAX's
+  dispatch).
+- ``DeviceSource``: a jittable ``f(i) -> payload`` applied to the global tuple index
+  array via ``vmap`` — generation happens *on device*, the idiomatic-TPU fast path for
+  synthetic/benchmark streams (the reference's benchmark sources are CPU loops filling
+  tuples, e.g. ``src/GPU_Tests/new_tests/benchmarks/gpu_map_stateful.cpp``).
+- key/ts assignment: ``key_fn(i)``, ``ts_fn(i)`` or constants, mirroring
+  ``setControlFields``.
+
+EOS: a source declares ``total`` tuples (or the generator ends); the tail batch is
+mask-padded, never shape-changed — the no-recompilation flush discipline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basic import routing_modes_t, DEFAULT_BATCH_SIZE
+from ..batch import Batch, CTRL_DTYPE
+from ..context import RuntimeContext
+from ..meta import classify_source
+from .base import Basic_Operator
+
+
+class SourceBase(Basic_Operator):
+    routing = routing_modes_t.NONE
+
+    def batches(self, batch_size: int) -> Iterator[Batch]:
+        raise NotImplementedError
+
+    def payload_spec(self) -> Any:
+        raise NotImplementedError
+
+
+class DeviceSource(SourceBase):
+    """Synthetic on-device source: ``payload = vmap(f)(global_index)``.
+
+    ``f`` runs inside the same compiled program as the downstream chain, so generation
+    fuses with the first operators (zero host->device traffic)."""
+
+    def __init__(self, fn: Callable, total: int, *, name: str = "source",
+                 parallelism: int = 1, key_fn: Callable = None, ts_fn: Callable = None,
+                 num_keys: int = 1, context: Optional[RuntimeContext] = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.is_rich = classify_source(fn)
+        self.total = int(total)
+        self.key_fn = key_fn
+        self.ts_fn = ts_fn
+        self.num_keys = num_keys
+        self.context = context or RuntimeContext(parallelism, 0)
+
+    def make_batch(self, start: jax.Array, batch_size: int) -> Batch:
+        """Jittable: build the batch of global indices [start, start+batch_size)."""
+        i = start + jnp.arange(batch_size, dtype=CTRL_DTYPE)
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        payload = jax.vmap(fn)(i)
+        key = (jax.vmap(self.key_fn)(i).astype(CTRL_DTYPE) if self.key_fn
+               else (i % self.num_keys if self.num_keys > 1 else jnp.zeros_like(i)))
+        ts = jax.vmap(self.ts_fn)(i).astype(CTRL_DTYPE) if self.ts_fn else i
+        valid = i < self.total
+        return Batch(key=key, id=i, ts=ts, payload=payload, valid=valid)
+
+    def payload_spec(self):
+        i = jax.ShapeDtypeStruct((), CTRL_DTYPE)
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        out = jax.eval_shape(fn, i)
+        return out
+
+    def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
+        make = jax.jit(self.make_batch, static_argnums=1)
+        for start in range(0, self.total, batch_size):
+            yield make(jnp.asarray(start, CTRL_DTYPE), batch_size)
+
+
+class GeneratorSource(SourceBase):
+    """Host source: wraps an iterator of payload pytrees (numpy arrays of equal leading
+    size <= batch_size) or ``(payload, key, ts)`` triples. The general-ingest path."""
+
+    def __init__(self, it_factory: Callable[[], Iterator], spec: Any, *,
+                 name: str = "source", parallelism: int = 1):
+        super().__init__(name, parallelism)
+        self.it_factory = it_factory
+        self._spec = spec
+
+    def payload_spec(self):
+        return self._spec
+
+    def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
+        next_id = 0
+        for item in self.it_factory():
+            if isinstance(item, Batch):
+                yield item
+                continue
+            if isinstance(item, tuple) and len(item) == 3:
+                payload, key, ts = item
+            else:
+                payload, key, ts = item, None, None
+            n = np.shape(jax.tree.leaves(payload)[0])[0]
+            if n > batch_size:
+                raise ValueError(f"generator yielded {n} > batch_size={batch_size}")
+            pad = batch_size - n
+
+            def pad_to(a):
+                a = np.asarray(a)
+                return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+            ids = np.arange(next_id, next_id + batch_size, dtype=np.int32)
+            next_id += n
+            yield Batch(
+                key=jnp.asarray(pad_to(key) if key is not None else np.zeros(batch_size, np.int32)),
+                id=jnp.asarray(ids),
+                ts=jnp.asarray(pad_to(ts) if ts is not None else ids),
+                payload=jax.tree.map(lambda a: jnp.asarray(pad_to(a)), payload),
+                valid=jnp.asarray(np.arange(batch_size) < n),
+            )
+
+
+# reference-style alias
+Source = DeviceSource

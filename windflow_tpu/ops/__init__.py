@@ -1,0 +1,1 @@
+from . import compaction, segment  # noqa: F401

@@ -1,0 +1,69 @@
+"""Stream compaction: pack live lanes to the front of a fixed-capacity buffer.
+
+Counterpart of the reference's device prefix-scan suite (Blelloch ``prescan``,
+``gather_sums``, ``map_to_target`` at ``wf/gpu_utils.hpp:323-417``) used by the GPU
+emitter to build per-destination sub-batches (``wf/standard_nodes_gpu.hpp:52-238``).
+On TPU we express the same thing with ``cumsum`` + scatter/gather, which XLA lowers
+well; a sort-based stable partition is provided as the robust default (the reference's
+own scattering study crowns sort-by-key at high fan-out,
+``src/GPU_Tests/scattering/results_scattering.org``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def exclusive_scan(x: jax.Array) -> jax.Array:
+    """Exclusive prefix sum (the reference's ``prescan``, ``wf/gpu_utils.hpp:330-360``)."""
+    return jnp.cumsum(x) - x
+
+
+def compact_indices(valid: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Return (gather_idx, out_valid): positions such that taking ``gather_idx`` packs
+    live lanes to the front in stable order; ``out_valid[i] = i < count``."""
+    c = valid.shape[0]
+    # stable partition via argsort on the invalid flag
+    order = jnp.argsort(jnp.where(valid, 0, 1), stable=True)
+    count = jnp.sum(valid.astype(jnp.int32))
+    out_valid = jnp.arange(c, dtype=jnp.int32) < count
+    return order, out_valid
+
+
+def scatter_compact(values: Any, valid: jax.Array, capacity: int = None) -> Tuple[Any, jax.Array]:
+    """Scatter-based compaction: live lane i goes to position exclusive_scan(valid)[i].
+    Returns (packed pytree, out_valid). ``capacity`` defaults to the input size."""
+    c = valid.shape[0]
+    cap = capacity or c
+    pos = exclusive_scan(valid.astype(jnp.int32))
+    tgt = jnp.where(valid, pos, cap)  # dead lanes dropped via OOB scatter
+
+    def one(v):
+        out = jnp.zeros((cap,) + v.shape[1:], v.dtype)
+        return out.at[tgt].set(v, mode="drop")
+    count = jnp.sum(valid.astype(jnp.int32))
+    out_valid = jnp.arange(cap, dtype=jnp.int32) < count
+    return jax.tree.map(one, values), out_valid
+
+
+def partition_by_destination(dest: jax.Array, valid: jax.Array, n_dest: int,
+                             capacity_per_dest: int):
+    """Group lanes by destination: returns (gather_idx ``[n_dest, cap]``, out_valid
+    ``[n_dest, cap]``). The device-side counterpart of the GPU keyed-scatter emitter
+    building per-destination sub-batches (``wf/standard_nodes_gpu.hpp:60-238``)."""
+    c = dest.shape[0]
+    key = jnp.where(valid, dest, n_dest)
+    order = jnp.argsort(key, stable=True)          # lanes grouped by destination
+    sorted_key = jnp.take(key, order)
+    # per-destination counts and offsets
+    counts = jax.ops.segment_sum(jnp.ones((c,), jnp.int32),
+                                 jnp.minimum(sorted_key, n_dest), num_segments=n_dest + 1)[:n_dest]
+    offsets = jnp.cumsum(counts) - counts
+    lane = jnp.arange(capacity_per_dest, dtype=jnp.int32)
+    gather_idx = offsets[:, None] + lane[None, :]
+    out_valid = lane[None, :] < counts[:, None]
+    gather_idx = jnp.clip(gather_idx, 0, c - 1)
+    return jnp.take(order, gather_idx), out_valid

@@ -1,0 +1,100 @@
+"""Segmented (per-key) reductions and scans over micro-batches.
+
+This is the device-side replacement for the reference's KEYBY routing
+(``wf/standard_emitter.hpp:85-110``: hash(key) -> replica queue): instead of scattering
+tuples to per-key threads, a whole batch stays on device and per-key semantics are
+recovered with segment operations. The reference's own GPU scattering study found
+sort-by-key the winning strategy at high fan-out
+(``src/GPU_Tests/scattering/results_scattering.org``) — which is exactly the plan here.
+
+All functions are mask-aware: invalid lanes contribute the combine identity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _bmask(valid, v):
+    """Broadcast a [C] mask against a [C, ...] value."""
+    return valid.reshape(valid.shape + (1,) * (v.ndim - 1))
+
+
+def _sorted_segment_scan(values, keys, valid, combine, identity):
+    """Stable sort by (invalid, key), then segmented inclusive associative scan.
+
+    Returns (scanned values in sorted order, sort order, sorted keys, sorted valid)."""
+    sort_key = jnp.where(valid, keys, jnp.iinfo(keys.dtype).max)
+    order = jnp.argsort(sort_key, stable=True)
+    seg_keys = jnp.take(sort_key, order)
+    seg_valid = jnp.take(valid, order)
+    sv = jax.tree.map(lambda v: jnp.take(v, order, axis=0), values)
+    sv = jax.tree.map(lambda v: jnp.where(_bmask(seg_valid, v), v,
+                                          jnp.asarray(identity, v.dtype)), sv)
+    starts = jnp.concatenate([jnp.ones((1,), jnp.bool_), seg_keys[1:] != seg_keys[:-1]])
+
+    def seg_combine(a, b):
+        # flag = True once a segment boundary has been crossed in the combined range;
+        # when b starts its own segment, discard a's contribution.
+        a_f, a_v = a
+        b_f, b_v = b
+        v = jax.tree.map(
+            lambda x, y: jnp.where(_bmask(b_f, y), y, combine(x, y)), a_v, b_v)
+        return (a_f | b_f, v)
+
+    _, scanned = jax.lax.associative_scan(seg_combine, (starts, sv), axis=0)
+    return scanned, order, seg_keys, seg_valid
+
+
+def segment_reduce(values: Any, keys: jax.Array, valid: jax.Array, num_keys: int,
+                   combine: Callable = None, identity=0) -> Any:
+    """Per-key reduction of a batch: returns a pytree of ``[num_keys, ...]`` arrays.
+
+    Default combine is addition (lowered to ``segment_sum``); a custom associative
+    ``combine(a, b)`` uses sort-by-key + segmented associative scan."""
+    if combine is None:
+        def red(v):
+            v = jnp.where(_bmask(valid, v), v, 0)
+            return jax.ops.segment_sum(v, keys, num_segments=num_keys)
+        return jax.tree.map(red, values)
+    scanned, order, seg_keys, seg_valid = _sorted_segment_scan(
+        values, keys, valid, combine, identity)
+    # last live position of each segment: where the next sorted key differs
+    nxt = jnp.concatenate([seg_keys[1:], jnp.full((1,), -1, seg_keys.dtype)])
+    is_last = (seg_keys != nxt) & seg_valid
+    out_idx = jnp.where(is_last, seg_keys, num_keys)  # non-lasts go to an overflow row
+
+    def scatter(v):
+        shape = (num_keys + 1,) + v.shape[1:]
+        init = jnp.full(shape, identity, v.dtype)
+        return init.at[out_idx].set(v, mode="drop")[:num_keys]
+    return jax.tree.map(scatter, scanned)
+
+
+def segment_prefix_scan(values: Any, keys: jax.Array, valid: jax.Array,
+                        combine: Callable, identity=0, *, carry_in: Any = None) -> Any:
+    """Per-key *inclusive* prefix scan in stream order: lane i receives the combine of
+    all earlier live same-key lanes (plus an optional per-key ``carry_in`` table
+    ``[num_keys, ...]``), returned in original batch positions.
+
+    Batched counterpart of the reference Accumulator's per-key rolling reduce
+    (``wf/accumulator.hpp:61``, keyMap ``:103-104``) for associative user combines:
+    stable sort-by-key (stream order preserved within key) + segmented
+    ``associative_scan`` + unsort."""
+    if carry_in is not None:
+        values = jax.tree.map(
+            lambda v, t: combine(jnp.take(t, keys, axis=0), v), values, carry_in)
+    scanned, order, _, _ = _sorted_segment_scan(values, keys, valid, combine, identity)
+    inv = jnp.argsort(order)
+    return jax.tree.map(lambda v: jnp.take(v, inv, axis=0), scanned)
+
+
+def segment_rank(keys: jax.Array, valid: jax.Array) -> jax.Array:
+    """Rank of each live lane among live lanes with the same key (0-based), in stream
+    order. Used to assign per-key progressive positions (archive slots, CB indices)."""
+    ones = valid.astype(jnp.int32)
+    incl = segment_prefix_scan(ones, keys, valid, jnp.add, 0)
+    return incl - ones  # exclusive

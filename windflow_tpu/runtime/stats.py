@@ -1,0 +1,83 @@
+"""Per-replica statistics — counterpart of ``Stats_Record`` (``wf/stats_record.hpp:50-156``).
+
+The reference counts inputs/outputs/bytes and service times per replica, plus GPU
+counters (kernels launched, H2D/D2H bytes, ``wf/stats_record.hpp:76-80``), dumped to
+``log/<pid>_<op>_<replica>.log``. Here the equivalents are per-operator host-side
+counters updated by the scheduler (batches are counted on host; per-tuple counts come
+from batch occupancy), including device-program launches and host<->HBM transfer bytes.
+Always on (cheap), dumped via ``dump_to_file`` like ``dump_toFile``
+(``wf/stats_record.hpp:109-155``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class Stats_Record:
+    def __init__(self, op_name: str, replica_id: int = 0):
+        self.op_name = op_name
+        self.replica_id = replica_id
+        self.start_time = time.monotonic()
+        self.inputs_received = 0
+        self.bytes_received = 0
+        self.outputs_sent = 0
+        self.bytes_sent = 0
+        self.batches_received = 0
+        self.batches_sent = 0
+        # device counters (reference GPU fields, wf/stats_record.hpp:76-80)
+        self.num_kernels = 0          # compiled-program launches
+        self.bytes_copied_hd = 0      # host -> HBM
+        self.bytes_copied_dh = 0      # HBM -> host
+        self._service_time_sum = 0.0
+        self._service_samples = 0
+
+    def record_input(self, n_tuples: int, n_bytes: int = 0):
+        self.inputs_received += int(n_tuples)
+        self.bytes_received += int(n_bytes)
+        self.batches_received += 1
+
+    def record_output(self, n_tuples: int, n_bytes: int = 0):
+        self.outputs_sent += int(n_tuples)
+        self.bytes_sent += int(n_bytes)
+        self.batches_sent += 1
+
+    def record_launch(self, service_time_s: float = 0.0, hd_bytes: int = 0, dh_bytes: int = 0):
+        self.num_kernels += 1
+        self.bytes_copied_hd += int(hd_bytes)
+        self.bytes_copied_dh += int(dh_bytes)
+        self._service_time_sum += service_time_s
+        self._service_samples += 1
+
+    @property
+    def avg_service_time_us(self) -> float:
+        if not self._service_samples:
+            return 0.0
+        return 1e6 * self._service_time_sum / self._service_samples
+
+    def as_dict(self) -> dict:
+        return {
+            "operator": self.op_name,
+            "replica": self.replica_id,
+            "inputs_received": self.inputs_received,
+            "outputs_sent": self.outputs_sent,
+            "bytes_received": self.bytes_received,
+            "bytes_sent": self.bytes_sent,
+            "batches_received": self.batches_received,
+            "batches_sent": self.batches_sent,
+            "num_kernels": self.num_kernels,
+            "bytes_copied_hd": self.bytes_copied_hd,
+            "bytes_copied_dh": self.bytes_copied_dh,
+            "avg_service_time_us": self.avg_service_time_us,
+            "uptime_s": time.monotonic() - self.start_time,
+        }
+
+    def dump_to_file(self, log_dir: str = "log"):
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir,
+                            f"{os.getpid()}_{self.op_name}_{self.replica_id}.json")
+        with open(path, "w") as f:
+            json.dump(self.as_dict(), f, indent=2)
+        return path

@@ -1,0 +1,42 @@
+"""Shipper — push-style output handle for Source and FlatMap user code.
+
+Counterpart of ``wf/shipper.hpp:50-104`` (``push`` at ``:85-103``). The reference
+Shipper heap-allocates and sends one tuple per push; here pushes are *recorded during
+tracing* (under ``vmap``) and stacked into fixed fan-out slots, which makes FlatMap's
+1:N expansion XLA-static: an input batch of capacity C with max fan-out F yields an
+output batch of capacity C*F with a validity mask.
+
+``push(payload, when=..., key=..., ts=...)`` supports data-dependent emission via the
+``when`` mask (the traced analogue of conditionally calling ``shipper.push`` in C++).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+
+
+class Shipper:
+    def __init__(self, max_fanout: int):
+        self.max_fanout = int(max_fanout)
+        self._payloads: List[Any] = []
+        self._whens: List[Any] = []
+        self._keys: List[Optional[Any]] = []
+        self._ts: List[Optional[Any]] = []
+        self.delivered = 0  # trace-time push count (reference counts delivered tuples)
+
+    def push(self, payload: Any, *, when=True, key=None, ts=None):
+        if len(self._payloads) >= self.max_fanout:
+            raise ValueError(
+                f"Shipper: more than max_fanout={self.max_fanout} pushes; raise "
+                f"max_fanout on the FlatMap/Source builder")
+        self._payloads.append(payload)
+        self._whens.append(jnp.asarray(when, jnp.bool_))
+        self._keys.append(key)
+        self._ts.append(ts)
+        self.delivered += 1
+
+    # accessors used by the FlatMap implementation
+    def _recorded(self):
+        return self._payloads, self._whens, self._keys, self._ts
