@@ -1,0 +1,102 @@
+"""End-to-end tests of the minimum slice: Source -> Map -> Filter -> Sink.
+
+Mirrors the reference oracle pattern (src/graph_test/test_graph_1.cpp:77-87): run the
+same stream with different batch sizes / configurations and assert the sink total is
+invariant — result invariance under execution geometry is the core property."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+
+
+def _expected_sum(total):
+    # source i -> value i; map v -> v*2+1; filter keeps even ids
+    s = 0
+    for i in range(total):
+        if i % 2 == 0:
+            s += i * 2 + 1
+    return s
+
+
+def build(total, batch_size):
+    src = wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total, num_keys=4)
+    m = wf.Map(lambda t: {"v": t.v * 2 + 1})
+    f = wf.Filter(lambda t: t.id % 2 == 0)
+    rsink = wf.ReduceSink(lambda t: t.v.astype(jnp.int64)
+                          if False else t.v.astype(jnp.int32))
+    return wf.Pipeline(src, [m, f, rsink], batch_size=batch_size)
+
+
+def test_map_filter_reduce_sum():
+    total = 1000
+    res = build(total, 128).run()
+    assert int(res["reduce_sink"]) == _expected_sum(total)
+
+
+def test_invariance_under_batch_size():
+    total = 777  # non-multiple of batch size: exercises tail masking
+    sums = []
+    for bs in (64, 100, 777, 1024):
+        res = build(total, bs).run()
+        sums.append(int(res["reduce_sink"]))
+    assert len(set(sums)) == 1
+    assert sums[0] == _expected_sum(total)
+
+
+def test_host_sink_receives_live_tuples_only():
+    total = 100
+    got = {"ids": [], "eos": 0}
+
+    def cb(view):
+        if view is None:
+            got["eos"] += 1
+            return
+        got["ids"].extend(view["id"].tolist())
+
+    src = wf.Source(lambda i: {"v": i * 1.0}, total=total)
+    f = wf.Filter(lambda t: t.v < 10)
+    sink = wf.Sink(cb)
+    wf.Pipeline(src, [f], sink, batch_size=32).run()
+    assert sorted(got["ids"]) == list(range(10))
+    assert got["eos"] == 1
+
+
+def test_flatmap_fanout():
+    total = 50
+    # each tuple emits v and -v (second push masked for odd ids)
+    def fm(t, shipper):
+        shipper.push({"v": t.v})
+        shipper.push({"v": -t.v}, when=t.id % 2 == 0)
+
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=total)
+    op = wf.FlatMap(fm, max_fanout=2)
+    rsink = wf.ReduceSink(lambda t: jnp.ones((), jnp.int32))  # count outputs
+    res = wf.Pipeline(src, [op, rsink], batch_size=16).run()
+    assert int(res["reduce_sink"]) == total + total // 2
+
+
+def test_filtermap_optional_variant():
+    total = 60
+    op = wf.FilterMap(lambda t: ({"w": t.v + 100.0}, t.v % 3 == 0))
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=total)
+    rsink = wf.ReduceSink(lambda t: t.w)
+    res = wf.Pipeline(src, [op, rsink], batch_size=25).run()
+    expect = sum(v + 100.0 for v in range(total) if v % 3 == 0)
+    np.testing.assert_allclose(float(res["reduce_sink"]), expect)
+
+
+def test_rich_map_receives_context():
+    total = 20
+    seen = []
+
+    def rich_map(t, ctx):
+        seen.append(ctx.getParallelism())
+        return {"v": t.v + ctx.getReplicaIndex()}
+
+    src = wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total)
+    m = wf.Map(rich_map, parallelism=3)
+    rsink = wf.ReduceSink(lambda t: t.v)
+    res = wf.Pipeline(src, [m, rsink], batch_size=8).run()
+    assert seen and seen[0] == 3
+    assert int(res["reduce_sink"]) == sum(range(total))

@@ -1,0 +1,22 @@
+"""windflow_tpu — a TPU-native data-stream-processing framework.
+
+Same capability surface as WindFlow (reference: cosimoagati/WindFlow, C++17 header-only
+stream processing on multicores + CUDA GPUs), re-architected for TPU: streams are
+sequences of fixed-capacity SoA micro-batches; operator chains compile to single XLA
+programs; keyed state lives in HBM tables; windows are batched rows fed to vmapped /
+Pallas kernels; parallelism is expressed with ``jax.sharding`` over device meshes.
+See SURVEY.md for the blueprint.
+"""
+
+from .basic import (Mode, win_type_t, opt_level_t, routing_modes_t, pattern_t,
+                    win_event_t, ordering_mode_t, role_t,
+                    current_time_usecs, current_time_nsecs, WinOperatorConfig)
+from .batch import Batch, TupleRef, tuple_refs, concat_batches
+from .context import RuntimeContext, LocalStorage
+from .shipper import Shipper
+from .operators import (Basic_Operator, Source, DeviceSource, GeneratorSource,
+                        Map, KeyedMap, Filter, FilterMap, Compact, FlatMap,
+                        Accumulator, Sink, ReduceSink)
+from .runtime import CompiledChain, Pipeline, Stats_Record
+
+__version__ = "0.1.0"

@@ -165,6 +165,7 @@ def concat_batches(a: Batch, b: Batch) -> Batch:
     )
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class TupleRef:
     """Per-tuple view handed to user functions under ``vmap`` — the counterpart of the

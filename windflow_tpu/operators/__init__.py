@@ -1,0 +1,13 @@
+from .base import Basic_Operator
+from .source import Source, DeviceSource, GeneratorSource, SourceBase
+from .map import Map, KeyedMap
+from .filter import Filter, FilterMap, Compact
+from .flatmap import FlatMap
+from .accumulator import Accumulator
+from .sink import Sink, ReduceSink
+
+__all__ = [
+    "Basic_Operator", "Source", "DeviceSource", "GeneratorSource", "SourceBase",
+    "Map", "KeyedMap", "Filter", "FilterMap", "Compact", "FlatMap",
+    "Accumulator", "Sink", "ReduceSink",
+]

@@ -14,7 +14,7 @@ from typing import Any, Optional, Tuple
 
 from ..basic import routing_modes_t
 from ..batch import Batch
-from ..runtime.stats import Stats_Record
+from ..stats import Stats_Record
 
 
 class Basic_Operator:
@@ -56,6 +56,17 @@ class Basic_Operator:
     parallelism = property(getParallelism)
 
     # -- batch-transform surface ------------------------------------------------------
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        """Called once by the compiler with the incoming micro-batch capacity, before
+        ``init_state`` — lets stateful operators size rings/budgets relative to the
+        batch (the reference sizes GPU batches similarly from batch_len/slide gcds,
+        ``wf/win_seq_gpu.hpp`` tuples_per_batch)."""
+
+    def out_capacity(self, in_capacity: int) -> int:
+        """Capacity of the outgoing batch (FlatMap expands by max_fanout; windowed
+        operators emit max_wins rows)."""
+        return in_capacity
 
     def init_state(self, payload_spec: Any) -> Any:
         """Device state pytree for this operator (None if stateless)."""

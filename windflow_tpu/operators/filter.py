@@ -1,0 +1,79 @@
+"""Filter — drop-by-predicate.
+
+Counterpart of ``wf/filter.hpp`` (class at ``:60``, signature slots ``:63-76``): the
+reference supports ``bool(tuple&)`` plus optional-returning transforming variants and
+rich forms. Here the predicate ``f(t) -> bool`` runs under ``vmap`` and *intersects the
+validity mask* — no data movement at all, the cheapest possible filter on TPU (the
+reference's FilterGPU computes a mask then compacts with a device scan,
+``wf/filter_gpu_node.hpp``; here compaction is a separate opt-in ``Compact`` operator
+since downstream operators are mask-aware).
+
+The transforming variant (reference ``optional<result>(const tuple&)``) is covered by
+``FilterMap``: ``f(t) -> (payload, keep)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t
+from ..batch import Batch, tuple_refs
+from ..context import RuntimeContext
+from ..meta import classify_filter
+from .base import Basic_Operator
+
+
+class Filter(Basic_Operator):
+    def __init__(self, fn: Callable, *, name: str = "filter", parallelism: int = 1,
+                 keyed: bool = False, context: Optional[RuntimeContext] = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.is_rich = classify_filter(fn)
+        self.routing = routing_modes_t.KEYBY if keyed else routing_modes_t.FORWARD
+        self.context = context or RuntimeContext(parallelism, 0)
+
+    def apply(self, state, batch: Batch):
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        keep = jax.vmap(fn)(tuple_refs(batch))
+        return state, batch.mask(jnp.asarray(keep, jnp.bool_))
+
+
+class FilterMap(Basic_Operator):
+    """Transform + drop in one op: ``f(t) -> (payload, keep)`` — the reference's
+    ``optional<result>(const tuple&)`` Filter signature (``wf/filter.hpp:63-76``)."""
+
+    def __init__(self, fn: Callable, *, name: str = "filtermap", parallelism: int = 1,
+                 context: Optional[RuntimeContext] = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.is_rich = classify_filter(fn)
+        self.context = context or RuntimeContext(parallelism, 0)
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        from ..batch import TupleRef
+        t = TupleRef(key=jax.ShapeDtypeStruct((), jnp.int32),
+                     id=jax.ShapeDtypeStruct((), jnp.int32),
+                     ts=jax.ShapeDtypeStruct((), jnp.int32), data=payload_spec)
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        out, _ = jax.eval_shape(fn, t)
+        return out
+
+    def apply(self, state, batch: Batch):
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        payload, keep = jax.vmap(fn)(tuple_refs(batch))
+        return state, batch.with_payload(payload).mask(jnp.asarray(keep, jnp.bool_))
+
+
+class Compact(Basic_Operator):
+    """Pack live lanes to the front (stable). Opt-in densification after filters with
+    low selectivity — the explicit analogue of the reference GPU compaction pass
+    (``wf/standard_nodes_gpu.hpp:52-238``)."""
+
+    def __init__(self, *, name: str = "compact"):
+        super().__init__(name, 1)
+
+    def apply(self, state, batch: Batch):
+        return state, batch.compact()

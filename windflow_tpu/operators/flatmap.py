@@ -1,0 +1,86 @@
+"""FlatMap — one-to-many transformation.
+
+Counterpart of ``wf/flatmap.hpp`` (class at ``:61``; per-replica Shipper member
+``:90-91``): the reference signature is ``void(const tuple&, Shipper<result>&)``
+(+rich). Here the same push-style API works under tracing: the user function receives a
+:class:`~windflow_tpu.shipper.Shipper` and calls ``shipper.push(payload, when=...)`` up
+to ``max_fanout`` times; pushes are recorded at trace time and stacked, producing an
+output batch of capacity ``C * max_fanout`` with a validity mask (data-dependent counts
+via the ``when`` mask — XLA-static shapes, no recompilation).
+
+Output control fields: pushed tuples inherit the input's ``(key, ts)`` unless
+overridden per push; ``id`` is re-derived downstream (windowed consumers renumber —
+reference emit_counter semantics, ``wf/win_seq.hpp:433-441``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t
+from ..batch import Batch, TupleRef, tuple_refs
+from ..context import RuntimeContext
+from ..meta import classify_flatmap
+from ..shipper import Shipper
+from .base import Basic_Operator
+
+
+class FlatMap(Basic_Operator):
+    def __init__(self, fn: Callable, *, max_fanout: int, name: str = "flatmap",
+                 parallelism: int = 1, context: Optional[RuntimeContext] = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.is_rich = classify_flatmap(fn)
+        self.max_fanout = int(max_fanout)
+        self.context = context or RuntimeContext(parallelism, 0)
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return in_capacity * self.max_fanout
+
+    def _per_tuple(self, t: TupleRef):
+        """Run the user fn for one tuple; returns stacked (payload[F], when[F], key[F], ts[F])."""
+        sh = Shipper(self.max_fanout)
+        if self.is_rich:
+            self.fn(t, sh, self.context)
+        else:
+            self.fn(t, sh)
+        payloads, whens, keys, tss = sh._recorded()
+        n = len(payloads)
+        if n == 0:
+            raise ValueError("FlatMap function pushed nothing (need >=1 traced push; "
+                             "use when=False for conditional no-emit)")
+        # pad up to max_fanout with copies of slot 0, masked off
+        F = self.max_fanout
+        pay = payloads + [payloads[0]] * (F - n)
+        whn = whens + [jnp.asarray(False)] * (F - n)
+        key = [k if k is not None else t.key for k in keys] + [t.key] * (F - n)
+        ts = [x if x is not None else t.ts for x in tss] + [t.ts] * (F - n)
+        stack = lambda xs: jax.tree.map(lambda *ls: jnp.stack(ls), *xs)
+        return (stack(pay), jnp.stack(whn),
+                jnp.stack([jnp.asarray(k, jnp.int32) for k in key]),
+                jnp.stack([jnp.asarray(x, jnp.int32) for x in ts]))
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        t = TupleRef(key=jax.ShapeDtypeStruct((), jnp.int32),
+                     id=jax.ShapeDtypeStruct((), jnp.int32),
+                     ts=jax.ShapeDtypeStruct((), jnp.int32), data=payload_spec)
+        out, _, _, _ = jax.eval_shape(self._per_tuple, t)
+        # strip the fan-out axis
+        return jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), out)
+
+    def apply(self, state, batch: Batch):
+        C, F = batch.capacity, self.max_fanout
+        pay, when, key, ts = jax.vmap(self._per_tuple)(tuple_refs(batch))
+        flat = lambda a: a.reshape((C * F,) + a.shape[2:])
+        out = Batch(
+            key=flat(key),
+            id=flat(jnp.broadcast_to(batch.id[:, None], (C, F)) * F
+                    + jnp.arange(F, dtype=jnp.int32)[None, :]),
+            ts=flat(ts),
+            payload=jax.tree.map(flat, pay),
+            valid=flat(when & batch.valid[:, None]),
+        )
+        return state, out

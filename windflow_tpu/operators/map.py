@@ -1,0 +1,131 @@
+"""Map — one-to-one transformation.
+
+Counterpart of ``wf/map.hpp`` (class at ``:60``, signature slots ``:64-74``): the
+reference accepts in-place ``void(tuple&)`` and non-in-place ``void(const tuple&,
+result&)`` signatures, plus rich variants, with optional KEYBY routing. Here the user
+function is per-tuple pure ``f(t) -> payload`` (or rich ``f(t, ctx)``), lifted over the
+batch with ``vmap``; XLA fuses it with neighbours, which is what makes a chained
+Source->Map->Filter->Sink pipeline one device program (the micro-batch analogue of the
+reference's ``MapGPU`` kernels, ``wf/map_gpu_node.hpp:57-125``).
+
+Keyed (stateful) Map — the reference fork's headline feature (``run_map_kernel_keyed_*``
+per-key scratchpads, ``wf/map_gpu_node.hpp:216-222``) — takes ``state_spec`` +
+``f(t, state) -> (payload, state)``: per-key state lives in an HBM table ``[K, ...]``
+and is gather/scatter-updated per batch. Within one batch, tuples of the same key are
+folded sequentially per key (matching the reference's per-key serialization semantics)
+via a masked scan over the batch's per-key rank.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
+from ..batch import Batch, tuple_refs, TupleRef
+from ..context import RuntimeContext
+from ..meta import classify_map
+from .base import Basic_Operator
+
+
+class Map(Basic_Operator):
+    def __init__(self, fn: Callable, *, name: str = "map", parallelism: int = 1,
+                 keyed: bool = False, context: Optional[RuntimeContext] = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.is_rich = classify_map(fn)
+        self.routing = routing_modes_t.KEYBY if keyed else routing_modes_t.FORWARD
+        self.context = context or RuntimeContext(parallelism, 0)
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        t = TupleRef(key=jax.ShapeDtypeStruct((), jnp.int32),
+                     id=jax.ShapeDtypeStruct((), jnp.int32),
+                     ts=jax.ShapeDtypeStruct((), jnp.int32), data=payload_spec)
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        return jax.eval_shape(fn, t)
+
+    def apply(self, state, batch: Batch):
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        payload = jax.vmap(fn)(tuple_refs(batch))
+        return state, batch.with_payload(payload)
+
+
+class KeyedMap(Basic_Operator):
+    """Stateful map with a per-key HBM state table.
+
+    ``f(t, state_k) -> (payload, new_state_k)``; ``init_state_value`` is the per-key
+    initial state pytree. The fast path assumes at most one live tuple per key per
+    batch *or* an associative-style independence; the exact sequential-within-key
+    semantics are provided by ``ordered=True`` which folds same-key tuples in stream
+    order with ``lax.scan`` over the max per-key multiplicity."""
+
+    routing = routing_modes_t.KEYBY
+
+    def __init__(self, fn: Callable, init_state_value: Any, *, num_keys: int = DEFAULT_MAX_KEYS,
+                 name: str = "map_keyed", parallelism: int = 1, ordered: bool = True,
+                 max_key_multiplicity: int = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.init_value = init_state_value
+        self.num_keys = int(num_keys)
+        self.ordered = ordered
+        self.max_key_multiplicity = max_key_multiplicity
+
+    def init_state(self, payload_spec: Any):
+        return jax.tree.map(
+            lambda v: jnp.broadcast_to(jnp.asarray(v), (self.num_keys,) + jnp.shape(jnp.asarray(v))).copy(),
+            self.init_value)
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        t = TupleRef(key=jax.ShapeDtypeStruct((), jnp.int32),
+                     id=jax.ShapeDtypeStruct((), jnp.int32),
+                     ts=jax.ShapeDtypeStruct((), jnp.int32), data=payload_spec)
+        out, _ = jax.eval_shape(lambda tt: self.fn(tt, self.init_value), t)
+        return out
+
+    def apply(self, state, batch: Batch):
+        from ..ops.segment import segment_rank
+        refs = tuple_refs(batch)
+        rank = segment_rank(batch.key, batch.valid)
+        # Fold same-key tuples in stream order: round r processes the lanes whose
+        # per-key rank is r (gather state row, apply fn, scatter updated row). Rounds
+        # run up to the *observed* max multiplicity in this batch — for well-spread
+        # keys that is 1-2 rounds; callers that guarantee one tuple per key per batch
+        # can set max_key_multiplicity=1 to make it a single static round. This is the
+        # per-key serialization the reference documents as its stateful floor
+        # (1 key => 0.44-0.64 M t/s, results.org:8,37) — but paid only *within* a
+        # batch, not across the whole stream.
+        if self.max_key_multiplicity == 1 or not self.ordered:
+            st_k = jax.tree.map(lambda tbl: jnp.take(tbl, batch.key, axis=0), state)
+            res, new_st = jax.vmap(self.fn)(refs, st_k)
+            safe_key = jnp.where(batch.valid, batch.key, self.num_keys)
+            state = jax.tree.map(
+                lambda tbl, ns: tbl.at[safe_key].set(ns, mode="drop"), state, new_st)
+            return state, batch.with_payload(res)
+
+        max_rank = jnp.max(jnp.where(batch.valid, rank, 0))
+
+        def round_body(r, carry):
+            st, out_payload = carry
+            active = batch.valid & (rank == r)
+            st_k = jax.tree.map(lambda tbl: jnp.take(tbl, batch.key, axis=0), st)
+            res, new_st = jax.vmap(self.fn)(refs, st_k)
+            safe_key = jnp.where(active, batch.key, self.num_keys)
+            st = jax.tree.map(
+                lambda tbl, ns: tbl.at[safe_key].set(ns, mode="drop"), st, new_st)
+            out_payload = jax.tree.map(
+                lambda o, nv: jnp.where(
+                    active.reshape(active.shape + (1,) * (nv.ndim - 1)), nv, o),
+                out_payload, res)
+            return st, out_payload
+
+        out_shape = jax.eval_shape(
+            lambda s, b: jax.vmap(self.fn)(
+                tuple_refs(b), jax.tree.map(lambda t: jnp.take(t, b.key, axis=0), s))[0],
+            state, batch)
+        out0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
+        state, out_payload = jax.lax.fori_loop(
+            0, max_rank + 1, round_body, (state, out0))
+        return state, batch.with_payload(out_payload)

@@ -1,0 +1,91 @@
+"""Sink — stream absorption.
+
+Counterpart of ``wf/sink.hpp`` (class at ``:67``, signature slots ``:70-77``): the
+reference calls ``void(optional<tuple>&)`` per tuple (empty optional at EOS). Two
+TPU-native flavours:
+
+- ``Sink``: host callback invoked once per *batch* with the live tuples as numpy
+  arrays (``f(batch_view)`` / rich) — the general egress path. Called with ``None`` at
+  EOS, mirroring the empty-optional convention.
+- ``ReduceSink``: an in-graph reduction (e.g. global sum / count / collect-last) that
+  stays on device and is fetched once at the end — this is what the reference test
+  suites do with their ``atomic<long> global_sum`` oracle
+  (``src/graph_test/graph_common.hpp:32``), and avoids D2H per batch entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basic import routing_modes_t
+from ..batch import Batch, tuple_refs
+from ..context import RuntimeContext
+from ..meta import classify_sink
+from .base import Basic_Operator
+
+
+class Sink(Basic_Operator):
+    """Host-callback sink. The callback receives a dict with numpy ``key/id/ts``,
+    payload leaves restricted to live lanes."""
+
+    def __init__(self, fn: Callable, *, name: str = "sink", parallelism: int = 1,
+                 keyed: bool = False, context: Optional[RuntimeContext] = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.is_rich = classify_sink(fn)
+        self.routing = routing_modes_t.KEYBY if keyed else routing_modes_t.FORWARD
+        self.context = context or RuntimeContext(parallelism, 0)
+
+    def consume(self, batch: Optional[Batch]):
+        """Host-side: deliver one batch (or None at EOS) to the user callback."""
+        if batch is None:
+            view = None
+        else:
+            host = jax.tree.map(np.asarray, batch)
+            v = host.valid
+            if not v.any():
+                return
+            view = {
+                "key": host.key[v], "id": host.id[v], "ts": host.ts[v],
+                "payload": jax.tree.map(lambda a: a[v], host.payload),
+            }
+        if self.is_rich:
+            self.fn(view, self.context)
+        else:
+            self.fn(view)
+
+
+class ReduceSink(Basic_Operator):
+    """In-graph reduction sink: ``value_fn(t) -> pytree`` per tuple, associative
+    ``combine`` across all tuples of the stream (device-resident accumulator)."""
+
+    def __init__(self, value_fn: Callable, *, combine: Callable = None, identity=0,
+                 name: str = "reduce_sink", parallelism: int = 1):
+        super().__init__(name, parallelism)
+        self.value_fn = value_fn
+        self.combine = combine or jnp.add
+        self.identity = identity
+
+    def init_state(self, payload_spec: Any):
+        from .accumulator import _ref_spec
+        val = jax.eval_shape(self.value_fn, _ref_spec(payload_spec))
+        return jax.tree.map(lambda s: jnp.full(s.shape, self.identity, s.dtype), val)
+
+    def apply(self, state, batch: Batch):
+        vals = jax.vmap(self.value_fn)(tuple_refs(batch))
+        def red(acc, v):
+            m = batch.valid.reshape(batch.valid.shape + (1,) * (v.ndim - 1))
+            v = jnp.where(m, v, jnp.asarray(self.identity, v.dtype))
+            if self.combine is jnp.add:
+                return acc + jnp.sum(v, axis=0)
+            return self.combine(acc, jax.lax.reduce(
+                v, jnp.asarray(self.identity, v.dtype), self.combine, (0,)))
+        state = jax.tree.map(red, state, vals)
+        return state, batch
+
+    def result(self, state):
+        return state

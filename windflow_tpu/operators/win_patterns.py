@@ -1,0 +1,229 @@
+"""Parallel window patterns: Win_Farm, Key_Farm, Key_FFAT, Pane_Farm, Win_MapReduce.
+
+The reference implements each pattern as a distinct thread topology around ``Win_Seq``
+workers (``wf/win_farm.hpp``, ``wf/key_farm.hpp``, ``wf/key_ffat.hpp``,
+``wf/pane_farm.hpp``, ``wf/win_mapreduce.hpp``). On TPU the *batched window axis* plays
+the role of the worker pool — every fired window is a row processed in parallel by one
+compiled program — so each pattern reduces to a configuration/composition of the
+vectorized engines plus a sharding recipe for multi-chip (``parallel/sharding.py``):
+
+- **Win_Farm** (``wf/win_farm.hpp:65-666``): N replicas each own every N-th window
+  (private slide = slide*N, ``:165-175``), fed by a multicast WF_Emitter
+  (``wf/wf_nodes.hpp:110-204``). Here: windows are already independent rows of the
+  [W] axis — "ownership" is row index; multi-chip shards the W axis (window w on
+  device w % p — the emitter arithmetic as a sharding rule). No tuple multicast
+  exists because the archive is shared in HBM rather than copied per replica.
+- **Key_Farm** (``wf/key_farm.hpp:68-641``): whole keys routed to replicas
+  (KF_Emitter, ``wf/kf_nodes.hpp:43-111``). Here: the [K] state axis; multi-chip
+  shards the key-state tables (key k on device hash(k) % p).
+- **Key_FFAT** (``wf/key_ffat.hpp:65-246``): Key_Farm whose workers are Win_SeqFFAT —
+  directly ``Win_SeqFFAT`` with key-axis sharding.
+- **Pane_Farm** (``wf/pane_farm.hpp:66-1012``): pane decomposition, PLQ computes
+  pane partials (pane_len = gcd(win, slide), ``:175``), WLQ combines pane results
+  per window. Here: PLQ = tumbling Win_Seq over panes, WLQ = Win_Seq over the pane
+  result stream — two engines fused in one compiled program (the LEVEL2 flattening,
+  ``:222-260``, is the default and only mode).
+- **Win_MapReduce** (``wf/win_mapreduce.hpp:63-1002``): each window's content is
+  round-robin partitioned across ``map_parallelism`` workers (WinMap_Emitter,
+  ``wf/wm_nodes.hpp:45-181``), partials reduced. Here: gather the window row [L],
+  reshape to [M, L/M] partitions, vmap MAP over partitions, tree-reduce with REDUCE —
+  all inside the window-axis vmap; multi-chip shards the M axis with a psum-style
+  combine over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t, role_t, pattern_t, DEFAULT_MAX_KEYS
+from ..batch import Batch, CTRL_DTYPE, TupleRef
+from .base import Basic_Operator
+from .window import Iterable, WindowSpec
+from .win_seq import Win_Seq
+from .win_seqffat import Win_SeqFFAT
+
+
+class Win_Farm(Win_Seq):
+    """Keyless (or keyed) window parallelism. ``parallelism`` declares the number of
+    window-axis shards for multi-chip; single-chip, the [W] axis is already the farm.
+    The reference's emitter math (window w owned by replica (hash(key)%p + w) % p,
+    ``wf/wf_nodes.hpp:182-204``) becomes the sharding rule of the W axis."""
+
+    pattern = pattern_t.WF_CPU
+    shard_axis = "window"
+
+    def __init__(self, win_fn, spec: WindowSpec, *, parallelism: int = 1,
+                 num_keys: int = 1, name: str = "win_farm", **kw):
+        super().__init__(win_fn, spec, num_keys=num_keys, name=name,
+                         parallelism=parallelism, **kw)
+        self.routing = routing_modes_t.COMPLEX
+
+
+class Key_Farm(Win_Seq):
+    """Keyed window parallelism: keys partitioned over replicas, each key's windows
+    computed sequentially in order (``wf/key_farm.hpp``). The [K] state axis is the
+    farm; multi-chip shards it."""
+
+    pattern = pattern_t.KF_CPU
+    shard_axis = "key"
+
+    def __init__(self, win_fn, spec: WindowSpec, *, parallelism: int = 1,
+                 num_keys: int = DEFAULT_MAX_KEYS, name: str = "key_farm", **kw):
+        super().__init__(win_fn, spec, num_keys=num_keys, name=name,
+                         parallelism=parallelism, **kw)
+
+
+class Key_FFAT(Win_SeqFFAT):
+    """Key_Farm with FlatFAT-style associative incremental workers
+    (``wf/key_ffat.hpp:65-246``): pane-partial sharing + key-axis sharding."""
+
+    pattern = pattern_t.KFF_CPU
+    shard_axis = "key"
+
+    def __init__(self, lift, combine, *, spec: WindowSpec, parallelism: int = 1,
+                 num_keys: int = DEFAULT_MAX_KEYS, name: str = "key_ffat", **kw):
+        super().__init__(lift, combine, spec=spec, num_keys=num_keys, name=name,
+                         parallelism=parallelism, **kw)
+
+
+class Pane_Farm(Basic_Operator):
+    """Pane decomposition (Li et al. SIGMOD'05; ``wf/pane_farm.hpp``).
+
+    ``plq_fn(pane_id, iterable) -> pane_result`` runs once per pane;
+    ``wlq_fn(wid, iterable_of_pane_results) -> result`` combines the panes of each
+    window. Sliding windows only (slide < win_len, enforced like ``:170-173``).
+    Composed of two vectorized engines executing in the same program."""
+
+    routing = routing_modes_t.KEYBY
+    pattern = pattern_t.PF_CPU
+
+    def __init__(self, plq_fn: Callable, wlq_fn: Callable, spec: WindowSpec, *,
+                 num_keys: int = DEFAULT_MAX_KEYS, name: str = "pane_farm",
+                 plq_parallelism: int = 1, wlq_parallelism: int = 1, **kw):
+        import math
+        super().__init__(name, max(plq_parallelism, wlq_parallelism))
+        if spec.slide >= spec.win_len:
+            raise ValueError("Pane_Farm requires sliding windows (slide < win_len), "
+                             "wf/pane_farm.hpp:170-173")
+        self.spec = spec
+        self.pane_len = math.gcd(spec.win_len, spec.slide)
+        self.wpanes = spec.win_len // self.pane_len
+        self.spanes = spec.slide // self.pane_len
+        # PLQ: tumbling windows of one pane, same window type as the outer spec
+        plq_spec = WindowSpec(self.pane_len, self.pane_len, spec.wtype, spec.delay)
+        self.plq = Win_Seq(plq_fn, plq_spec, num_keys=num_keys, role=role_t.PLQ,
+                           name=f"{name}_plq", **kw)
+        # WLQ consumes the pane-result stream: CB windows counted in pane results
+        # (panes arrive per key in ascending order without gaps for CB; for TB, pane
+        # results carry ts = pane end time and WLQ windows stay time-based)
+        if spec.is_cb:
+            wlq_spec = WindowSpec(self.wpanes, self.spanes)
+        else:
+            wlq_spec = WindowSpec(spec.win_len, spec.slide, spec.wtype)
+        self.wlq = Win_Seq(wlq_fn, wlq_spec, num_keys=num_keys, role=role_t.WLQ,
+                           name=f"{name}_wlq")
+        self._wlq_id_fix = spec.is_cb
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        self.plq.bind_geometry(batch_capacity)
+        self.wlq.bind_geometry(self.plq.out_capacity(batch_capacity))
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return self.wlq.out_capacity(self.plq.out_capacity(in_capacity))
+
+    def init_state(self, payload_spec: Any):
+        return {"plq": self.plq.init_state(payload_spec),
+                "wlq": self.wlq.init_state(self.plq.out_spec(payload_spec))}
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        return self.wlq.out_spec(self.plq.out_spec(payload_spec))
+
+    def _fix_pane_batch(self, panes: Batch) -> Batch:
+        """Pane results enter WLQ as a tuple stream; for TB mode their ts must be the
+        pane close time (set by Win_Seq already for TB panes)."""
+        return panes
+
+    def apply(self, state, batch: Batch):
+        st_p, panes = self.plq.apply(state["plq"], batch)
+        st_w, out = self.wlq.apply(state["wlq"], self._fix_pane_batch(panes))
+        return {"plq": st_p, "wlq": st_w}, out
+
+    def flush(self, state):
+        st_p, panes = self.plq.flush(state["plq"])
+        if panes is not None:
+            st_w, out = self.wlq.apply(state["wlq"], self._fix_pane_batch(panes))
+            return {"plq": st_p, "wlq": st_w}, out
+        st_w, out = self.wlq.flush(state["wlq"])
+        return {"plq": st_p, "wlq": st_w}, out
+
+
+class Win_MapReduce(Basic_Operator):
+    """Window partitioning: each window's content is split round-robin across
+    ``map_parallelism`` partitions, MAP computes per-partition partials, REDUCE
+    combines them (``wf/win_mapreduce.hpp:63-230``, emitters ``wf/wm_nodes.hpp``).
+
+    ``map_fn(wid, iterable) -> partial`` per partition;
+    ``reduce_fn(wid, iterable_of_partials) -> result`` over the M partials.
+    CB windows only for the round-robin partition arithmetic (the reference's TB
+    nesting case broadcasts + drops, ``wf/pipegraph.hpp:1922-1930``)."""
+
+    routing = routing_modes_t.KEYBY
+    pattern = pattern_t.WMR_CPU
+
+    def __init__(self, map_fn: Callable, reduce_fn: Callable, spec: WindowSpec, *,
+                 map_parallelism: int = 2, num_keys: int = DEFAULT_MAX_KEYS,
+                 name: str = "win_mapreduce", **kw):
+        super().__init__(name, map_parallelism)
+        if not spec.is_cb:
+            raise NotImplementedError("Win_MapReduce currently supports CB windows "
+                                      "(reference MAP partitioning is round-robin by "
+                                      "position, wf/wm_nodes.hpp:45-181)")
+        if spec.win_len % map_parallelism:
+            raise ValueError("win_len must be divisible by map_parallelism")
+        self.spec = spec
+        self.M = int(map_parallelism)
+        self.map_fn = map_fn
+        self.reduce_fn = reduce_fn
+        # the underlying archive/firing machinery is a Win_Seq whose window function
+        # does partition-map + reduce inside the per-window vmap
+        self.engine = Win_Seq(self._window_fn, spec, num_keys=num_keys,
+                              name=f"{name}_engine", role=role_t.MAP, **kw)
+
+    def _window_fn(self, wid, it: Iterable):
+        L, M = self.spec.win_len, self.M
+        P = L // M
+        # round-robin partition p gets positions p, p+M, p+2M, ... (WinMap_Emitter
+        # scatter, wf/wm_nodes.hpp:45-181): reshape [L] -> [P, M] -> transpose [M, P]
+        part = lambda a: jnp.swapaxes(a.reshape((P, M) + a.shape[1:]), 0, 1)
+        sub = Iterable(data=jax.tree.map(part, it.data), ids=part(it.ids),
+                       ts=part(it.ts), mask=part(it.mask))
+        partials = jax.vmap(lambda s: self.map_fn(wid, s))(sub)
+        # REDUCE over the M partials (CB window of length M in the reference,
+        # wf/win_mapreduce.hpp:180-230)
+        red_it = Iterable(
+            data=partials,
+            ids=jnp.arange(M, dtype=CTRL_DTYPE),
+            ts=jnp.broadcast_to(jnp.asarray(0, CTRL_DTYPE), (M,)),
+            mask=jnp.ones((M,), jnp.bool_))
+        return self.reduce_fn(wid, red_it)
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        self.engine.bind_geometry(batch_capacity)
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return self.engine.out_capacity(in_capacity)
+
+    def init_state(self, payload_spec: Any):
+        return self.engine.init_state(payload_spec)
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        return self.engine.out_spec(payload_spec)
+
+    def apply(self, state, batch: Batch):
+        return self.engine.apply(state, batch)
+
+    def flush(self, state):
+        return self.engine.flush(state)

@@ -1,0 +1,279 @@
+"""Win_Seq — THE sequential window engine, vectorized.
+
+Counterpart of ``wf/win_seq.hpp:56-567`` (svc ``:304-465``, EOS flush ``:468-529``)
+with ``StreamArchive`` (``wf/stream_archive.hpp``) fused in: per-key archives live as
+HBM ring buffers ``[K, A]``; each micro-batch (1) scatters its tuples into the rings,
+(2) advances per-key counts/watermarks, (3) computes the FIRED window range per key
+with batch-level triggerer arithmetic (``window.py``), (4) gathers up to ``max_wins``
+fired windows as rows ``[W, L]`` and (5) applies the user window function across the
+window axis with ``vmap`` — the direct TPU generalization of the reference GPU engine's
+one-thread-per-window ``ComputeBatch_Kernel`` (``wf/win_seq_gpu.hpp:57-82,352-560``),
+with the whole archive resident on device (no H2D flattening step at all).
+
+User function flavours (``wf/meta.hpp`` window families):
+- non-incremental: ``f(wid, iterable) -> result_payload`` over an :class:`Iterable`;
+- incremental (fold): ``f(wid, t, acc) -> acc`` via ``lax.scan`` across the window axis
+  (``winupdate_func`` semantics, ``wf/win_seq.hpp:389-397``).
+
+CB windows index per-key *arrival position* (the reference's TS_RENUMBERING-style
+progressive ids, ``wf/basic.hpp:129``); TB windows index timestamps with per-key
+watermarks and ``delay`` lateness. Windows whose turn exceeds the per-batch ``max_wins``
+budget defer to the next batch (``next_win`` only advances past emitted windows).
+
+Emission order is per-key ascending window id — the ordered-collector guarantee of
+``WF_Collector`` (``wf/wf_nodes.hpp:253-318``) by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t, role_t, DEFAULT_MAX_KEYS
+from ..batch import Batch, CTRL_DTYPE, TupleRef
+from ..meta import classify_window, classify_winupdate
+from ..ops.segment import segment_rank, segment_reduce
+from .base import Basic_Operator
+from .window import Iterable, WindowSpec
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class WinSeqState:
+    arch_payload: Any     # pytree [K, A, ...]
+    arch_id: jax.Array    # i32[K, A] global tuple id of each slot
+    arch_ts: jax.Array    # i32[K, A]
+    arch_pos: jax.Array   # i32[K, A] arrival position held by slot (-1 = empty)
+    count: jax.Array      # i32[K] tuples archived per key
+    wm: jax.Array         # i32[K] per-key max ts seen
+    next_win: jax.Array   # i32[K] next window id to fire
+
+
+class Win_Seq(Basic_Operator):
+    routing = routing_modes_t.KEYBY
+
+    def __init__(self, win_fn: Callable, spec: WindowSpec, *,
+                 incremental: bool = False, init_acc: Any = None,
+                 num_keys: int = DEFAULT_MAX_KEYS, archive_capacity: int = None,
+                 max_wins: int = None, tb_capacity: int = None,
+                 name: str = "win_seq", parallelism: int = 1,
+                 role: role_t = role_t.SEQ):
+        super().__init__(name, parallelism)
+        self.win_fn = win_fn
+        self.spec = spec
+        self.incremental = incremental
+        self.init_acc = init_acc
+        if incremental:
+            self.is_rich = classify_winupdate(win_fn)
+            if init_acc is None:
+                raise ValueError("incremental window function requires init_acc")
+        else:
+            self.is_rich = classify_window(win_fn)
+        self.num_keys = int(num_keys)
+        self.role = role
+        self._archive_capacity = archive_capacity
+        self._tb_capacity = tb_capacity
+        self.A = None                  # resolved in bind_geometry
+        self.max_wins = max_wins       # resolved at first apply if None
+        self._w = None
+        self.bind_geometry(256)        # provisional; compiler re-binds with real C
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        L = self.spec.win_len
+        if self._archive_capacity is not None:
+            self.A = _next_pow2(self._archive_capacity)
+        elif self.spec.is_cb:
+            # ring must survive one whole batch landing on a single key before the
+            # fire phase runs, plus the open-window span
+            self.A = _next_pow2(L + batch_capacity)
+        else:
+            self.A = _next_pow2(self._tb_capacity or 2 * batch_capacity)
+
+    # ------------------------------------------------------------------ state
+
+    def init_state(self, payload_spec: Any):
+        K, A = self.num_keys, self.A
+        def mk(s):
+            return jnp.zeros((K, A) + tuple(s.shape), s.dtype)
+        return WinSeqState(
+            arch_payload=jax.tree.map(mk, payload_spec),
+            arch_id=jnp.zeros((K, A), CTRL_DTYPE),
+            arch_ts=jnp.zeros((K, A), CTRL_DTYPE),
+            arch_pos=jnp.full((K, A), -1, CTRL_DTYPE),
+            count=jnp.zeros((K,), CTRL_DTYPE),
+            wm=jnp.full((K,), -1, CTRL_DTYPE),
+            next_win=jnp.zeros((K,), CTRL_DTYPE),
+        )
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        L = self.spec.win_len if self.spec.is_cb else self.A
+        it = Iterable(
+            data=jax.tree.map(lambda s: jax.ShapeDtypeStruct((L,) + s.shape, s.dtype),
+                              payload_spec),
+            ids=jax.ShapeDtypeStruct((L,), CTRL_DTYPE),
+            ts=jax.ShapeDtypeStruct((L,), CTRL_DTYPE),
+            mask=jax.ShapeDtypeStruct((L,), jnp.bool_),
+        )
+        wid = jax.ShapeDtypeStruct((), CTRL_DTYPE)
+        if not self.incremental:
+            return jax.eval_shape(self.win_fn, wid, it)
+        t = TupleRef(key=wid, id=wid, ts=wid,
+                     data=jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+                                       payload_spec))
+        acc = jax.eval_shape(lambda: jax.tree.map(jnp.asarray, self.init_acc))
+        return jax.eval_shape(self.win_fn, wid, t, acc)
+
+    # ------------------------------------------------------------------ insert
+
+    def _insert(self, state: WinSeqState, batch: Batch) -> WinSeqState:
+        K, A = self.num_keys, self.A
+        valid = batch.valid
+        if not self.spec.is_cb:
+            # drop OLD tuples: they precede the purge horizon (already-fired windows)
+            horizon = jnp.take(state.next_win, batch.key) * self.spec.slide
+            valid = valid & (batch.ts >= horizon)
+        rank = segment_rank(batch.key, valid)
+        pos = jnp.take(state.count, batch.key) + rank
+        slot = pos % A
+        flat = jnp.where(valid, batch.key * A + slot, K * A)  # OOB -> dropped
+
+        def scat(tbl, v):
+            return tbl.reshape((K * A,) + tbl.shape[2:]).at[flat].set(
+                v, mode="drop").reshape(tbl.shape)
+
+        counts_add = segment_reduce(valid.astype(CTRL_DTYPE), batch.key, valid, K)
+        ts_max = segment_reduce(batch.ts, batch.key, valid, K,
+                                combine=jnp.maximum, identity=-1)
+        return dataclasses.replace(
+            state,
+            arch_payload=jax.tree.map(scat, state.arch_payload, batch.payload),
+            arch_id=scat(state.arch_id, batch.id),
+            arch_ts=scat(state.arch_ts, batch.ts),
+            arch_pos=scat(state.arch_pos, pos),
+            count=state.count + counts_add,
+            wm=jnp.maximum(state.wm, ts_max),
+        )
+
+    # ------------------------------------------------------------------ fire
+
+    def _resolve_w(self, capacity: int) -> int:
+        if self.max_wins is not None:
+            return self.max_wins
+        return max(16, -(-capacity // self.spec.slide) + 64)
+
+    def _fired_range(self, state: WinSeqState, flush: bool):
+        s = self.spec
+        if s.is_cb:
+            hi = s.flush_hi_cb(state.count) if flush else s.fired_hi_cb(state.count)
+        else:
+            hi = (s.flush_hi_tb(state.wm, state.count > 0) if flush
+                  else s.fired_hi_tb(state.wm))
+        return state.next_win, jnp.maximum(hi, state.next_win)
+
+    def _emit(self, state: WinSeqState, W: int, flush: bool):
+        """Emit up to W fired windows (per-key ascending wid). Returns (state, Batch)."""
+        K, A = self.num_keys, self.A
+        s = self.spec
+        lo, hi = self._fired_range(state, flush)
+        n_f = hi - lo
+        csum = jnp.cumsum(n_f)
+        off = csum - n_f
+        total = csum[-1] if K > 0 else jnp.asarray(0, CTRL_DTYPE)
+        w_idx = jnp.arange(W, dtype=CTRL_DTYPE)
+        k_of = jnp.searchsorted(csum, w_idx, side="right").astype(CTRL_DTYPE)
+        k_safe = jnp.minimum(k_of, K - 1)
+        wid = jnp.take(lo, k_safe) + (w_idx - jnp.take(off, k_safe))
+        valid_w = w_idx < jnp.minimum(total, W)
+
+        # advance next_win past emitted windows
+        emitted_k = jnp.clip(jnp.minimum(total, W) - off, 0, n_f)
+        new_next = lo + emitted_k
+
+        if s.is_cb:
+            L = s.win_len
+            p = wid[:, None] * s.slide + jnp.arange(L, dtype=CTRL_DTYPE)[None, :]
+            slot = p % A
+            gflat = k_safe[:, None] * A + slot                         # [W, L]
+            def gat(tbl):
+                return jnp.take(tbl.reshape((K * A,) + tbl.shape[2:]), gflat, axis=0)
+            content_mask = (p < jnp.take(state.count, k_safe)[:, None]) & valid_w[:, None]
+            # stale-slot guard: the slot must actually hold position p
+            content_mask &= gat(state.arch_pos) == p
+            data = jax.tree.map(gat, state.arch_payload)
+            ids, tss = gat(state.arch_id), gat(state.arch_ts)
+            res_ts = jnp.max(jnp.where(content_mask, tss, -1), axis=1)
+        else:
+            # TB: full-ring rows masked by ts-in-range
+            def gat(tbl):
+                return jnp.take(tbl, k_safe, axis=0)                   # [W, A, ...]
+            tss = gat(state.arch_ts)
+            poss = gat(state.arch_pos)
+            w_start = (wid * s.slide)[:, None]
+            content_mask = ((poss >= 0) & (tss >= w_start)
+                            & (tss < w_start + s.win_len) & valid_w[:, None])
+            # ring-overwrite guard: slot must hold a live (not yet overwritten) pos
+            cnt = jnp.take(state.count, k_safe)[:, None]
+            content_mask &= poss >= jnp.maximum(0, cnt - A)
+            data = jax.tree.map(gat, state.arch_payload)
+            ids = gat(state.arch_id)
+            res_ts = wid * s.slide + (s.win_len - 1)
+
+        it = Iterable(data=data, ids=ids, ts=tss, mask=content_mask)
+        if self.incremental:
+            results = _fold_windows(self.win_fn, wid, it, self.init_acc)
+        else:
+            results = jax.vmap(self.win_fn)(wid, it)
+
+        out = Batch(key=k_safe, id=wid,
+                    ts=res_ts if s.is_cb else jnp.asarray(res_ts, CTRL_DTYPE),
+                    payload=results, valid=valid_w)
+        return dataclasses.replace(state, next_win=new_next), out
+
+    # ------------------------------------------------------------------ operator API
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return self._resolve_w(in_capacity)
+
+    def apply(self, state: WinSeqState, batch: Batch):
+        W = self._resolve_w(batch.capacity)
+        self._w = W
+        state = self._insert(state, batch)
+        return self._emit(state, W, flush=False)
+
+    def flush(self, state: WinSeqState):
+        W = self._w or self._resolve_w(256)
+        if not hasattr(self, "_flush_jit"):
+            self._flush_jit = jax.jit(lambda st: self._emit(st, W, flush=True))
+        state, out = self._flush_jit(state)
+        if not bool(jnp.any(out.valid)):
+            return state, None
+        return state, out
+
+
+def _fold_windows(fn, wids, it: Iterable, init_acc):
+    """Incremental path: lax.scan the user fold over the window axis, vmapped over
+    windows. Absent slots (mask False) skip the fold (wf/win_seq.hpp:389-397)."""
+    def one(wid, data, ids, ts, mask):
+        acc0 = jax.tree.map(jnp.asarray, init_acc)
+
+        def step(acc, x):
+            d, i, t, m = x
+            tref = TupleRef(key=wid, id=i, ts=t, data=d)
+            new = fn(wid, tref, acc)
+            acc = jax.tree.map(lambda a, n: jnp.where(m, n, a), acc, new)
+            return acc, None
+
+        acc, _ = jax.lax.scan(step, acc0, (data, ids, ts, mask))
+        return acc
+
+    return jax.vmap(one)(wids, it.data, it.ids, it.ts, it.mask)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p

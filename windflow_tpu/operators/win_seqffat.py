@@ -1,0 +1,262 @@
+"""Win_SeqFFAT — incremental associative window engine with pane-level sharing.
+
+Counterpart of ``wf/win_seqffat.hpp:57-694`` + ``wf/flatfat.hpp:52-400`` (FlatFAT,
+Tangwongsan et al. VLDB'15) and their GPU versions (``wf/win_seqffat_gpu.hpp``,
+``wf/flatfat_gpu.hpp:51-130``: per-level tree kernels + prefix/suffix walks). The goal
+of FlatFAT is *sharing*: O(log n) incremental update instead of recomputing each
+window from scratch.
+
+TPU re-design: the tree is replaced by **pane partials** (gcd-free: pane = slide for
+tumbling/sliding CB; configurable) — each tuple is lifted once (``lift(t) -> agg``) and
+segment-reduced into its (key, pane) partial; a fired window combines its
+``win_len/pane_len`` pane partials with a tree reduction over the pane axis. This is
+the same work-sharing as FlatFAT (each tuple touches O(1) partials; each window
+combines O(L/pane) —  with panes = slide that is the "no pane, no gain" decomposition
+the reference's Pane_Farm uses, ``wf/pane_farm.hpp:175``), expressed as segment ops the
+MXU/VPU likes instead of pointer-chasing tree levels. An exact prefix/suffix FlatFAT
+(for non-commutative combines needing strict in-order association) is provided by
+``ops/flatfat.py`` via ``associative_scan``.
+
+Requirements: ``combine`` associative with ``identity``; window result =
+``fold(combine, lifted tuples in window)`` — the Win_SeqFFAT contract (winLift +
+winComb functions, ``wf/builders.hpp`` WinSeqFFAT_Builder:950).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
+from ..batch import Batch, CTRL_DTYPE, TupleRef
+from ..ops.segment import segment_reduce
+from .base import Basic_Operator
+from .window import WindowSpec
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FFATState:
+    panes: Any            # pytree [K, P, ...] ring of pane partials
+    pane_count: jax.Array  # i32[K, P] tuples folded into each pane slot
+    pane_of: jax.Array    # i32[K, P] pane id held by each ring slot (-1 empty)
+    count: jax.Array      # i32[K] tuples seen per key (CB position source)
+    wm: jax.Array         # i32[K] per-key max ts
+    next_win: jax.Array   # i32[K]
+
+
+class Win_SeqFFAT(Basic_Operator):
+    routing = routing_modes_t.KEYBY
+
+    def __init__(self, lift: Callable, combine: Callable, *, spec: WindowSpec,
+                 identity: Any = 0, num_keys: int = DEFAULT_MAX_KEYS,
+                 pane_len: int = None, pane_capacity: int = None,
+                 max_wins: int = None, name: str = "win_seqffat",
+                 parallelism: int = 1):
+        super().__init__(name, parallelism)
+        import math
+        self.lift = lift
+        self.combine = combine
+        self.identity = identity
+        self.spec = spec
+        self.num_keys = int(num_keys)
+        # pane length: gcd(win, slide) — every window is a whole number of panes and
+        # every pane belongs to a whole number of windows (wf/pane_farm.hpp:175)
+        self.pane_len = pane_len or math.gcd(spec.win_len, spec.slide)
+        if spec.win_len % self.pane_len or spec.slide % self.pane_len:
+            raise ValueError("pane_len must divide both win_len and slide")
+        self.wpanes = spec.win_len // self.pane_len     # panes per window
+        self.spanes = spec.slide // self.pane_len       # panes per slide
+        self._pane_capacity = pane_capacity
+        self.P = None
+        self.max_wins = max_wins
+        self._w = None
+        self.bind_geometry(256)        # provisional; compiler re-binds with real C
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        if self._pane_capacity is not None:
+            self.P = _next_pow2(self._pane_capacity)
+        elif self.spec.is_cb:
+            # one batch on a single key touches at most C/pane_len + 1 new panes
+            self.P = _next_pow2(self.wpanes + batch_capacity // self.pane_len + 2)
+        else:
+            # TB: panes indexed by ts; hold two batches' worth of distinct panes
+            self.P = _next_pow2(self.wpanes + 2 * batch_capacity + 2)
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return self._resolve_w(in_capacity)
+
+    # ------------------------------------------------------------------ state
+
+    def _lift_spec(self, payload_spec):
+        t = TupleRef(key=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+                     id=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+                     ts=jax.ShapeDtypeStruct((), CTRL_DTYPE), data=payload_spec)
+        return jax.eval_shape(self.lift, t)
+
+    def init_state(self, payload_spec: Any):
+        K, P = self.num_keys, self.P
+        agg = self._lift_spec(payload_spec)
+        return FFATState(
+            panes=jax.tree.map(
+                lambda s: jnp.full((K, P) + s.shape, self.identity, s.dtype), agg),
+            pane_count=jnp.zeros((K, P), CTRL_DTYPE),
+            pane_of=jnp.full((K, P), -1, CTRL_DTYPE),
+            count=jnp.zeros((K,), CTRL_DTYPE),
+            wm=jnp.full((K,), -1, CTRL_DTYPE),
+            next_win=jnp.zeros((K,), CTRL_DTYPE),
+        )
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        return self._lift_spec(payload_spec)
+
+    # ------------------------------------------------------------------ insert
+
+    def _insert(self, state: FFATState, batch: Batch):
+        """Lift each tuple and fold it into its (key, pane) partial: the FlatFAT
+        'update leaf + bubble' (wf/flatfat.hpp:134-240) collapsed into one segment
+        reduction per batch."""
+        from ..ops.segment import segment_rank
+        K, P = self.num_keys, self.P
+        valid = batch.valid
+        if self.spec.is_cb:
+            rank = segment_rank(batch.key, valid)
+            pos = jnp.take(state.count, batch.key) + rank
+            pane = pos // self.pane_len
+        else:
+            horizon = jnp.take(state.next_win, batch.key) * self.spec.slide
+            valid = valid & (batch.ts >= horizon)
+            pane = batch.ts // self.pane_len
+        slot = pane % P
+        seg = jnp.where(valid, batch.key * P + slot, K * P)
+
+        lifted = jax.vmap(self.lift)(
+            TupleRef(key=batch.key, id=batch.id, ts=batch.ts, data=batch.payload))
+        # per-(key,pane-slot) partial of this batch
+        upd = segment_reduce(lifted, seg, valid, K * P,
+                             combine=None if self.combine is jnp.add else self.combine,
+                             identity=self.identity)
+        cnt_upd = segment_reduce(valid.astype(CTRL_DTYPE), seg, valid, K * P)
+        pane_id_upd = segment_reduce(pane, seg, valid, K * P,
+                                     combine=jnp.maximum, identity=-1)
+
+        touched = cnt_upd.reshape(K, P) > 0
+        new_pane_of = jnp.where(touched, pane_id_upd.reshape(K, P), state.pane_of)
+        # a slot whose pane id advanced (ring wrap) restarts from identity
+        fresh = touched & (new_pane_of != state.pane_of)
+
+        def fold(tbl, u):
+            u = u.reshape((K, P) + u.shape[1:])
+            t = jnp.where(_b(fresh, tbl), jnp.asarray(self.identity, tbl.dtype), tbl)
+            m = _b(touched, tbl)
+            if self.combine is jnp.add:
+                return jnp.where(m, t + u, t)
+            return jnp.where(m, self.combine(t, u), t)
+
+        counts_add = segment_reduce(valid.astype(CTRL_DTYPE), batch.key, valid, K)
+        ts_max = segment_reduce(batch.ts, batch.key, valid, K,
+                                combine=jnp.maximum, identity=-1)
+        return dataclasses.replace(
+            state,
+            panes=jax.tree.map(fold, state.panes, upd),
+            pane_count=jnp.where(fresh, 0, state.pane_count) + cnt_upd.reshape(K, P),
+            pane_of=new_pane_of,
+            count=state.count + counts_add,
+            wm=jnp.maximum(state.wm, ts_max),
+        )
+
+    # ------------------------------------------------------------------ fire
+
+    def _emit(self, state: FFATState, W: int, flush: bool):
+        K, P = self.num_keys, self.P
+        s = self.spec
+        if s.is_cb:
+            hi = (jnp.where(state.count > 0, (state.count - 1) // s.slide + 1, 0)
+                  if flush else jnp.maximum(0, (state.count - s.win_len) // s.slide + 1))
+        else:
+            hi = (jnp.where(state.count > 0, state.wm // s.slide + 1, 0)
+                  if flush else jnp.maximum(0, (state.wm - s.delay - s.win_len) // s.slide + 1))
+        lo = state.next_win
+        hi = jnp.maximum(hi, lo)
+        n_f = hi - lo
+        csum = jnp.cumsum(n_f)
+        off = csum - n_f
+        total = csum[-1]
+        w_idx = jnp.arange(W, dtype=CTRL_DTYPE)
+        k_of = jnp.searchsorted(csum, w_idx, side="right").astype(CTRL_DTYPE)
+        k_safe = jnp.minimum(k_of, K - 1)
+        wid = jnp.take(lo, k_safe) + (w_idx - jnp.take(off, k_safe))
+        valid_w = w_idx < jnp.minimum(total, W)
+        emitted_k = jnp.clip(jnp.minimum(total, W) - off, 0, n_f)
+
+        # gather the wpanes panes of each window and tree-reduce (getResult():
+        # wf/flatfat.hpp root read; here a log-depth reduction over the pane axis)
+        pane0 = wid * self.spanes
+        pane_ids = pane0[:, None] + jnp.arange(self.wpanes, dtype=CTRL_DTYPE)[None, :]
+        slot = pane_ids % P
+        gflat = k_safe[:, None] * P + slot                      # [W, wpanes]
+        live = jnp.take(state.pane_of.reshape(K * P), gflat) == pane_ids
+        live &= valid_w[:, None]
+
+        def gat_reduce(tbl):
+            g = jnp.take(tbl.reshape((K * P,) + tbl.shape[2:]), gflat, axis=0)
+            g = jnp.where(_b(live, g), g, jnp.asarray(self.identity, g.dtype))
+            if self.combine is jnp.add:
+                return jnp.sum(g, axis=1)
+            return _tree_reduce(self.combine, g, axis=1)
+
+        results = jax.tree.map(gat_reduce, state.panes)
+        res_ts = (wid * s.slide + s.win_len - 1 if not s.is_cb
+                  else jnp.zeros_like(wid))
+        out = Batch(key=k_safe, id=wid, ts=jnp.asarray(res_ts, CTRL_DTYPE),
+                    payload=results, valid=valid_w)
+        return dataclasses.replace(state, next_win=lo + emitted_k), out
+
+    # ------------------------------------------------------------------ operator API
+
+    def _resolve_w(self, capacity):
+        if self.max_wins is not None:
+            return self.max_wins
+        return max(16, -(-capacity // self.spec.slide) + 64)
+
+    def apply(self, state, batch: Batch):
+        W = self._resolve_w(batch.capacity)
+        self._w = W
+        state = self._insert(state, batch)
+        return self._emit(state, W, flush=False)
+
+    def flush(self, state):
+        W = self._w or self._resolve_w(256)
+        if not hasattr(self, "_flush_jit"):
+            self._flush_jit = jax.jit(lambda st: self._emit(st, W, flush=True))
+        state, out = self._flush_jit(state)
+        if not bool(jnp.any(out.valid)):
+            return state, None
+        return state, out
+
+
+def _b(mask, v):
+    return mask.reshape(mask.shape + (1,) * (v.ndim - mask.ndim))
+
+
+def _tree_reduce(combine, x, axis):
+    """Log-depth reduction with an arbitrary associative combine."""
+    n = x.shape[axis]
+    while n > 1:
+        half = n // 2
+        a = jax.lax.slice_in_dim(x, 0, half, axis=axis)
+        b = jax.lax.slice_in_dim(x, half, 2 * half, axis=axis)
+        rest = jax.lax.slice_in_dim(x, 2 * half, n, axis=axis)
+        x = jnp.concatenate([combine(a, b), rest], axis=axis)
+        n = half + (n - 2 * half)
+    return jnp.squeeze(x, axis=axis)
+
+
+def _next_pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p

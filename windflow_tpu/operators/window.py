@@ -1,0 +1,116 @@
+"""Windowing core: window descriptors, triggerers, the Iterable view.
+
+Counterparts of ``wf/window.hpp`` (``Triggerer_CB`` ``:48-80``, ``Triggerer_TB``
+``:83-121``, ``Window`` ``:124-298``), ``wf/stream_archive.hpp`` and
+``wf/iterable.hpp``. The reference triggers one window event per tuple; here the same
+arithmetic is *batch-level*:
+
+- CB window ``w`` covers per-key arrival positions ``[w*slide, w*slide + win_len)``;
+  a key with ``count`` archived tuples has every window with
+  ``w*slide + win_len <= count`` FIRED (``Triggerer_CB`` semantics).
+- TB window ``w`` covers timestamps ``[w*slide, w*slide + win_len)``; with per-key
+  watermark ``wm`` (max ts seen) and lateness ``delay``, every window with
+  ``w*slide + win_len <= wm - delay + 1`` is FIRED; tuples older than a fired+purged
+  window are OLD and dropped (``Triggerer_TB`` semantics incl. ``triggering_delay``).
+
+:class:`WindowSpec` carries (win_len, slide, type, delay) — the builder-visible window
+definition (``withCBWindows``/``withTBWindows``, ``wf/builders.hpp``).
+:class:`Iterable` is the random-access view over one fired window's content handed to
+non-incremental user functions (``wf/iterable.hpp:52-245``), mask-aware because TB
+windows have variable occupancy inside a fixed capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import win_type_t
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    win_len: int
+    slide: int
+    wtype: win_type_t = win_type_t.CB
+    delay: int = 0            # TB lateness (triggering_delay, wf/window.hpp:83-121)
+
+    def __post_init__(self):
+        if self.win_len <= 0 or self.slide <= 0:
+            raise ValueError("win_len and slide must be positive")
+
+    @property
+    def is_cb(self):
+        return self.wtype == win_type_t.CB
+
+    # batch-level triggerer arithmetic ------------------------------------------------
+
+    def fired_hi_cb(self, count):
+        """Exclusive upper bound of FIRED window ids for a key with ``count`` tuples."""
+        return jnp.maximum(0, (count - self.win_len) // self.slide + 1)
+
+    def fired_hi_tb(self, watermark):
+        """Exclusive upper bound of FIRED window ids under per-key watermark (max ts)."""
+        return jnp.maximum(0, (watermark - self.delay - self.win_len) // self.slide + 1)
+
+    def flush_hi_cb(self, count):
+        """At EOS every window with any content fires (partial allowed)."""
+        return jnp.where(count > 0, (count - 1) // self.slide + 1, 0)
+
+    def flush_hi_tb(self, max_ts, has_any):
+        return jnp.where(has_any, max_ts // self.slide + 1, 0)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Iterable:
+    """View over one fired window's content (under ``vmap``: one row).
+
+    ``data``: payload pytree ``[L, ...]``; ``ids``/``ts``: ``[L]``; ``mask``: ``[L]``
+    (False = absent slot — TB windows and EOS-flushed partial CB windows).
+    Mirrors ``wf/iterable.hpp`` (begin/end/at/size) in mask-aware form."""
+
+    data: Any
+    ids: jax.Array
+    ts: jax.Array
+    mask: jax.Array
+
+    def __getattr__(self, name):
+        data = object.__getattribute__(self, "data")
+        if isinstance(data, dict) and name in data:
+            return data[name]
+        raise AttributeError(name)
+
+    def size(self):
+        return jnp.sum(self.mask.astype(jnp.int32))
+
+    # mask-aware reductions (the common window aggregations)
+    def _masked(self, v, fill):
+        m = self.mask.reshape(self.mask.shape + (1,) * (v.ndim - 1))
+        return jnp.where(m, v, jnp.asarray(fill, v.dtype))
+
+    def sum(self, field=None):
+        v = self.data[field] if field else self.data
+        return jax.tree.map(lambda x: jnp.sum(self._masked(x, 0), axis=0), v)
+
+    def max(self, field=None):
+        v = self.data[field] if field else self.data
+        return jax.tree.map(
+            lambda x: jnp.max(self._masked(x, jnp.finfo(x.dtype).min
+                                           if jnp.issubdtype(x.dtype, jnp.floating)
+                                           else jnp.iinfo(x.dtype).min), axis=0), v)
+
+    def min(self, field=None):
+        v = self.data[field] if field else self.data
+        return jax.tree.map(
+            lambda x: jnp.min(self._masked(x, jnp.finfo(x.dtype).max
+                                           if jnp.issubdtype(x.dtype, jnp.floating)
+                                           else jnp.iinfo(x.dtype).max), axis=0), v)
+
+    def mean(self, field=None):
+        s = self.sum(field)
+        n = jnp.maximum(1, self.size())
+        return jax.tree.map(lambda x: x / n.astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32), s)

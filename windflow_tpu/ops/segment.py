@@ -84,12 +84,15 @@ def segment_prefix_scan(values: Any, keys: jax.Array, valid: jax.Array,
     (``wf/accumulator.hpp:61``, keyMap ``:103-104``) for associative user combines:
     stable sort-by-key (stream order preserved within key) + segmented
     ``associative_scan`` + unsort."""
-    if carry_in is not None:
-        values = jax.tree.map(
-            lambda v, t: combine(jnp.take(t, keys, axis=0), v), values, carry_in)
     scanned, order, _, _ = _sorted_segment_scan(values, keys, valid, combine, identity)
     inv = jnp.argsort(order)
-    return jax.tree.map(lambda v: jnp.take(v, inv, axis=0), scanned)
+    out = jax.tree.map(lambda v: jnp.take(v, inv, axis=0), scanned)
+    if carry_in is not None:
+        # associativity: fold(carry, v1..vr) == combine(carry, fold(v1..vr)), so the
+        # per-key carry is applied once, after the in-batch scan
+        out = jax.tree.map(
+            lambda v, t: combine(jnp.take(t, keys, axis=0), v), out, carry_in)
+    return out
 
 
 def segment_rank(keys: jax.Array, valid: jax.Array) -> jax.Array:

@@ -1,0 +1,4 @@
+from .pipeline import CompiledChain, Pipeline
+from ..stats import Stats_Record
+
+__all__ = ["CompiledChain", "Pipeline", "Stats_Record"]

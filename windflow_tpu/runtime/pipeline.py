@@ -1,0 +1,122 @@
+"""Linear operator chain compiled to one XLA program + host run loop.
+
+This is the execution core under MultiPipe: a chain of operators between shuffle-free
+boundaries compiles into ONE jitted ``step(states, batch) -> (states, out_batch)``.
+That is the TPU answer to the reference's two composition mechanisms at once:
+
+- ``chain()`` / ``ff_comb`` fusion (``wf/pipegraph.hpp:1272-1318``): adjacent operators
+  run with no queue hop — here they are *literally one program*, with XLA fusing the
+  elementwise bodies (the optimization the reference can only approximate with
+  ``ff_comb``).
+- the GPU micro-batch overlap (``was_batch_started`` double buffering,
+  ``wf/map_gpu_node.hpp:224-340``): JAX dispatch is async — the host loop builds/feeds
+  batch N+1 while the device executes batch N; no explicit stream management needed.
+
+EOS protocol: the source exhausts; then each stateful operator's ``flush`` drains
+residual state (partial windows etc. — reference ``eosnotify``, ``wf/win_seq.hpp:468-529``)
+and the flushed batches cascade through the *remaining* suffix of the chain. All flush
+paths reuse the same compiled shapes (mask padding, never shape change).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+
+from ..basic import DEFAULT_BATCH_SIZE
+from ..batch import Batch
+from ..operators.base import Basic_Operator
+from ..operators.sink import ReduceSink, Sink
+from ..operators.source import SourceBase
+
+
+class CompiledChain:
+    """Compile ``ops`` (no source/sink) into suffix-runnable jitted programs.
+
+    ``step_from(i)`` runs ops[i:] — used both for the main path (i=0) and for EOS
+    flush cascades starting after operator i."""
+
+    def __init__(self, ops: Sequence[Basic_Operator], in_spec: Any,
+                 batch_capacity: int = None):
+        self.ops = list(ops)
+        self.specs = [in_spec]          # specs[i] = input payload spec of ops[i]
+        cap = batch_capacity
+        for op in self.ops:
+            if cap is not None:
+                op.bind_geometry(cap)
+                cap = op.out_capacity(cap)
+            self.specs.append(op.out_spec(self.specs[-1]))
+        self.states = [op.init_state(self.specs[i]) for i, op in enumerate(self.ops)]
+        self._steps = {}
+
+    @property
+    def out_spec(self):
+        return self.specs[-1]
+
+    def _step_fn(self, i: int):
+        if i not in self._steps:
+            def step(states, batch):
+                states = list(states)
+                for j in range(i, len(self.ops)):
+                    states[j], batch = self.ops[j].apply(states[j], batch)
+                return tuple(states), batch
+            self._steps[i] = jax.jit(step)
+        return self._steps[i]
+
+    def push(self, batch: Batch, from_op: int = 0) -> Batch:
+        """Run one batch through ops[from_op:]; updates states; returns the out batch."""
+        states, out = self._step_fn(from_op)(tuple(self.states), batch)
+        self.states = list(states)
+        return out
+
+    def flush(self) -> List[Batch]:
+        """EOS: drain every operator in order, cascading flushed batches through the
+        remaining suffix. Returns the list of final out-batches produced."""
+        outs: List[Batch] = []
+        for i, op in enumerate(self.ops):
+            while True:
+                self.states[i], fb = op.flush(self.states[i])
+                if fb is None:
+                    break
+                if i + 1 < len(self.ops):
+                    outs.append(self.push(fb, from_op=i + 1))
+                else:
+                    outs.append(fb)
+        return outs
+
+    def result(self):
+        """Results of any ReduceSink-style terminal ops (device accumulators)."""
+        res = {}
+        for i, op in enumerate(self.ops):
+            if isinstance(op, ReduceSink):
+                res[op.name] = op.result(self.states[i])
+        return res
+
+
+class Pipeline:
+    """Source -> ops... -> sink, run batch-at-a-time. The minimum end-to-end slice
+    (SURVEY §7 step 3); MultiPipe builds on this per-segment."""
+
+    def __init__(self, source: SourceBase, ops: Sequence[Basic_Operator],
+                 sink: Optional[Sink] = None, *, batch_size: int = DEFAULT_BATCH_SIZE):
+        self.source = source
+        self.sink = sink
+        self.batch_size = batch_size
+        chain_ops = list(ops)
+        self.chain = CompiledChain(chain_ops, source.payload_spec(),
+                                   batch_capacity=batch_size)
+
+    def run(self):
+        stats = self.source.get_StatsRecords()[0]
+        for batch in self.source.batches(self.batch_size):
+            out = self.chain.push(batch)
+            stats.record_launch()
+            if self.sink is not None:
+                self.sink.consume(out)
+        for out in self.chain.flush():
+            if self.sink is not None:
+                self.sink.consume(out)
+        if self.sink is not None:
+            self.sink.consume(None)   # empty-optional EOS signal (wf/sink.hpp)
+        return self.chain.result()
