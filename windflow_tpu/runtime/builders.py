@@ -1,0 +1,311 @@
+"""Fluent builders — construction with build-time signature checking.
+
+Counterpart of ``wf/builders.hpp`` (13 CPU builders, ``:42-2195``) and
+``wf/builders_gpu.hpp`` (8 GPU builders with ``withBatch``/``withGPU``, ``:44-1433``).
+Common methods mirror the reference: ``withName``, ``withParallelism``,
+``enable_KeyBy``, ``withCBWindows``, ``withTBWindows``, ``withLateness``, ``withOpt``,
+``withBatch``; terminal ``build()`` returns the operator (``build_ptr``/``build_unique``
+aliases for API parity, ``wf/builders.hpp:583-643``). Signature validation happens at
+``build()`` via ``meta.classify_*`` — ill-formed user callables fail at graph-build
+time with the accepted-signature list, like the reference's static_asserts
+(``wf/builders.hpp:56-58``).
+
+Device parameters: the reference GPU builders take ``withBatch(batch_len)`` and
+``withGPU(gpu_id, n_thread_block)`` (``wf/builders_gpu.hpp:67-130``); the TPU
+equivalents are ``withBatch`` (micro-batch capacity hint) and ``withDevice(device)``
+(a ``jax.Device``), plus ``withMaxWins``/``withArchive`` for window-engine sizing
+(the scratchpad_size analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from ..basic import opt_level_t, win_type_t, DEFAULT_MAX_KEYS
+from ..operators.accumulator import Accumulator
+from ..operators.filter import Filter, FilterMap
+from ..operators.flatmap import FlatMap
+from ..operators.map import Map, KeyedMap
+from ..operators.sink import ReduceSink, Sink
+from ..operators.source import DeviceSource, GeneratorSource
+from ..operators.window import WindowSpec
+from ..operators.win_patterns import (Key_Farm, Key_FFAT, Pane_Farm, Win_Farm,
+                                      Win_MapReduce)
+from ..operators.win_seq import Win_Seq
+from ..operators.win_seqffat import Win_SeqFFAT
+
+
+class _Builder:
+    _cls: type = None
+
+    def __init__(self, *fns):
+        self._fns = fns
+        self._kw: dict = {}
+
+    def withName(self, name: str):
+        self._kw["name"] = name
+        return self
+
+    def withParallelism(self, n: int):
+        self._kw["parallelism"] = n
+        return self
+
+    def withOpt(self, level: opt_level_t):
+        # XLA fuses chained stages unconditionally; kept for parity (wf/basic.hpp:92)
+        self._opt = level
+        return self
+
+    def withBatch(self, batch_len: int):
+        self._kw.setdefault("_batch_hint", batch_len)
+        return self
+
+    def withDevice(self, device):
+        self._kw.setdefault("_device", device)
+        return self
+
+    def _pop_private(self):
+        self._kw.pop("_batch_hint", None)
+        self._kw.pop("_device", None)
+
+    def build(self):
+        self._pop_private()
+        return self._cls(*self._fns, **self._kw)
+
+    # C++ API parity aliases (wf/builders.hpp:583-643)
+    build_ptr = build
+    build_unique = build
+
+
+class Source_Builder(_Builder):
+    """``Source_Builder(f)`` with ``f(i) -> payload`` (+rich) — wf/builders.hpp:49."""
+    _cls = DeviceSource
+
+    def withTotal(self, total: int):
+        self._kw["total"] = total
+        return self
+
+    def withKeys(self, num_keys: int, key_fn: Callable = None):
+        self._kw["num_keys"] = num_keys
+        if key_fn is not None:
+            self._kw["key_fn"] = key_fn
+        return self
+
+    def withTimestamps(self, ts_fn: Callable):
+        self._kw["ts_fn"] = ts_fn
+        return self
+
+    def build(self):
+        self._pop_private()
+        if "total" not in self._kw:
+            raise ValueError("Source_Builder: withTotal(n) is required")
+        return DeviceSource(*self._fns, **self._kw)
+
+
+class Filter_Builder(_Builder):
+    """wf/builders.hpp:168; predicate ``f(t) -> bool`` (+rich)."""
+    _cls = Filter
+
+    def enable_KeyBy(self):
+        self._kw["keyed"] = True
+        return self
+
+
+class Map_Builder(_Builder):
+    """wf/builders.hpp:332; ``f(t) -> payload`` (+rich)."""
+    _cls = Map
+
+    def enable_KeyBy(self):
+        self._kw["keyed"] = True
+        return self
+
+
+class FlatMap_Builder(_Builder):
+    """wf/builders.hpp:494; ``f(t, shipper)`` (+rich)."""
+    _cls = FlatMap
+
+    def withMaxFanout(self, f: int):
+        self._kw["max_fanout"] = f
+        return self
+
+    def build(self):
+        self._pop_private()
+        if "max_fanout" not in self._kw:
+            raise ValueError("FlatMap_Builder: withMaxFanout(F) is required (static "
+                             "fan-out capacity makes 1:N XLA-static)")
+        return FlatMap(*self._fns, **self._kw)
+
+
+class Accumulator_Builder(_Builder):
+    """wf/builders.hpp:653; ``value_fn(t)`` + associative combine."""
+    _cls = Accumulator
+
+    def withInitialValue(self, v):
+        self._kw["init_value"] = v
+        return self
+
+    def withCombine(self, fn, identity=0):
+        self._kw["combine"] = fn
+        self._kw["identity"] = identity
+        return self
+
+    def withKeys(self, num_keys: int):
+        self._kw["num_keys"] = num_keys
+        return self
+
+
+class _WinBuilder(_Builder):
+    def __init__(self, *fns):
+        super().__init__(*fns)
+        self._win = None
+
+    def withCBWindows(self, win_len: int, slide: int):
+        self._win = WindowSpec(win_len, slide, win_type_t.CB)
+        return self
+
+    def withTBWindows(self, win_len: int, slide: int):
+        self._win = WindowSpec(win_len, slide, win_type_t.TB,
+                               self._win.delay if self._win else 0)
+        return self
+
+    def withLateness(self, delay: int):
+        if self._win is None or self._win.is_cb:
+            raise ValueError("withLateness applies to TB windows "
+                             "(triggering_delay, wf/window.hpp:83-121)")
+        self._win = WindowSpec(self._win.win_len, self._win.slide,
+                               self._win.wtype, delay)
+        return self
+
+    def withKeys(self, num_keys: int):
+        self._kw["num_keys"] = num_keys
+        return self
+
+    def withMaxWins(self, w: int):
+        self._kw["max_wins"] = w
+        return self
+
+    def withArchive(self, capacity: int):
+        self._kw["archive_capacity"] = capacity
+        return self
+
+    def prepare4Nesting(self):
+        return self
+
+    def _spec(self):
+        if self._win is None:
+            raise ValueError("window builder: call withCBWindows/withTBWindows first")
+        return self._win
+
+
+class WinSeq_Builder(_WinBuilder):
+    """wf/builders.hpp:789; ``f(wid, iterable) -> result`` or incremental via
+    ``withIncremental(init_acc)``."""
+    def withIncremental(self, init_acc):
+        self._kw["incremental"] = True
+        self._kw["init_acc"] = init_acc
+        return self
+
+    def build(self):
+        self._pop_private()
+        return Win_Seq(self._fns[0], self._spec(), **self._kw)
+
+
+class WinSeqFFAT_Builder(_WinBuilder):
+    """wf/builders.hpp:950; lift + combine (winLift/winComb)."""
+    def withIdentity(self, identity):
+        self._kw["identity"] = identity
+        return self
+
+    def build(self):
+        self._pop_private()
+        lift, comb = self._fns
+        return Win_SeqFFAT(lift, comb, spec=self._spec(), **self._kw)
+
+
+class WinFarm_Builder(_WinBuilder):
+    """wf/builders.hpp:1120."""
+    def build(self):
+        self._pop_private()
+        return Win_Farm(self._fns[0], self._spec(), **self._kw)
+
+
+class KeyFarm_Builder(_WinBuilder):
+    """wf/builders.hpp:1343."""
+    def build(self):
+        self._pop_private()
+        return Key_Farm(self._fns[0], self._spec(), **self._kw)
+
+
+class KeyFFAT_Builder(_WinBuilder):
+    """wf/builders.hpp:1569."""
+    def withIdentity(self, identity):
+        self._kw["identity"] = identity
+        return self
+
+    def build(self):
+        self._pop_private()
+        lift, comb = self._fns
+        return Key_FFAT(lift, comb, spec=self._spec(), **self._kw)
+
+
+class PaneFarm_Builder(_WinBuilder):
+    """wf/builders.hpp:1755; plq_fn + wlq_fn."""
+    def withPLQParallelism(self, n: int):
+        self._kw["plq_parallelism"] = n
+        return self
+
+    def withWLQParallelism(self, n: int):
+        self._kw["wlq_parallelism"] = n
+        return self
+
+    def build(self):
+        self._pop_private()
+        self._kw.pop("parallelism", None)
+        plq, wlq = self._fns
+        return Pane_Farm(plq, wlq, self._spec(), **self._kw)
+
+
+class WinMapReduce_Builder(_WinBuilder):
+    """wf/builders.hpp:1975; map_fn + reduce_fn."""
+    def withMapParallelism(self, n: int):
+        self._kw["map_parallelism"] = n
+        return self
+
+    def build(self):
+        self._pop_private()
+        self._kw.pop("parallelism", None)
+        m, r = self._fns
+        return Win_MapReduce(m, r, self._spec(), **self._kw)
+
+
+class Sink_Builder(_Builder):
+    """wf/builders.hpp:2195; host callback ``f(batch_view)`` (+rich)."""
+    _cls = Sink
+
+    def enable_KeyBy(self):
+        self._kw["keyed"] = True
+        return self
+
+
+class ReduceSink_Builder(_Builder):
+    _cls = ReduceSink
+
+    def withCombine(self, fn, identity=0):
+        self._kw["combine"] = fn
+        self._kw["identity"] = identity
+        return self
+
+
+# TPU builder aliases: the reference ships parallel *_GPU builders
+# (wf/builders_gpu.hpp:44-1433); here every operator IS the device operator, so the
+# _TPU names alias the same builders (MapGPU_Builder:1433 analogue included).
+MapTPU_Builder = Map_Builder
+FilterTPU_Builder = Filter_Builder
+WinSeqTPU_Builder = WinSeq_Builder
+WinSeqFFATTPU_Builder = WinSeqFFAT_Builder
+WinFarmTPU_Builder = WinFarm_Builder
+KeyFarmTPU_Builder = KeyFarm_Builder
+KeyFFATTPU_Builder = KeyFFAT_Builder
+PaneFarmTPU_Builder = PaneFarm_Builder
+WinMapReduceTPU_Builder = WinMapReduce_Builder
