@@ -1,0 +1,118 @@
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Headline: Yahoo Streaming Benchmark (YSB) throughput in tuples/sec on one chip —
+the north-star metric of BASELINE.json. The pipeline is the full YSB chain
+(event source -> filter(1/3) -> campaign join -> keyed tumbling TB window count ->
+device reduce sink) compiled as ONE XLA program per micro-batch, with event
+generation fused on device (the reference replays an in-memory dataset from its
+source threads; data never leaves the chip here either).
+
+vs_baseline compares against the reference CUDA backend's best published number,
+16.6 M tuples/s stateless MapGPU (BASELINE.md; the keyed-stateful CUDA peak is
+11.8 M t/s) — the bar the TPU backend must beat. Secondary metrics (stateless
+map+filter config, per-step latency ~ p99 window-result latency bound) go to stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+BATCH = int(os.environ.get("WF_BENCH_BATCH", 1 << 16))
+STEPS = int(os.environ.get("WF_BENCH_STEPS", 40))
+BASELINE_TPS = 16.6e6
+
+
+def _bench_loop(step, states, n_steps, batch):
+    import jax
+    # warmup/compile
+    states, out = step(states, 0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(1, n_steps + 1):
+        states, out = step(states, i * batch)
+        # async dispatch: the host enqueues step i+1 while the device runs step i
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return dt, states
+
+
+def bench_ysb():
+    import jax
+    import jax.numpy as jnp
+    from windflow_tpu.benchmarks import ysb
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    # pane ring: one batch spans BATCH/EVENTS_PER_TICK time units =
+    # BATCH/(EVENTS_PER_TICK*WIN_LEN) panes; hold 2 batches + the window span
+    panes_per_batch = BATCH // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN) + 1
+    src = ysb.make_source(total=(STEPS + 2) * BATCH)
+    ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
+                       max_wins=2 * panes_per_batch + 64)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+
+    def step(states, start):
+        batch = src.make_batch(jnp.asarray(start, jnp.int32), BATCH)
+        states = list(states)
+        for j, op in enumerate(chain.ops):
+            states[j], batch = op.apply(states[j], batch)
+        return tuple(states), batch.valid
+
+    step = jax.jit(step, donate_argnums=0)
+    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH)
+    return STEPS * BATCH / dt, dt / STEPS
+
+
+def bench_stateless():
+    """Config 2 of BASELINE.json: Source->Map->Filter->Sink micro-batch."""
+    import jax
+    import jax.numpy as jnp
+    from windflow_tpu.operators.map import Map
+    from windflow_tpu.operators.filter import Filter
+    from windflow_tpu.operators.sink import ReduceSink
+    from windflow_tpu.operators.source import DeviceSource
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    src = DeviceSource(lambda i: {"v": (i % 1000).astype(jnp.float32)},
+                       total=(STEPS + 2) * BATCH, num_keys=512)
+    ops = [Map(lambda t: {"v": t.v * 2.0 + 1.0}),
+           Filter(lambda t: t.v > 100.0),
+           ReduceSink(lambda t: t.v)]
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+
+    def step(states, start):
+        batch = src.make_batch(jnp.asarray(start, jnp.int32), BATCH)
+        states = list(states)
+        for j, op in enumerate(chain.ops):
+            states[j], batch = op.apply(states[j], batch)
+        return tuple(states), batch.valid
+
+    step = jax.jit(step, donate_argnums=0)
+    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH)
+    return STEPS * BATCH / dt, dt / STEPS
+
+
+def main():
+    import jax
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+
+    ysb_tps, ysb_step_s = bench_ysb()
+    sl_tps, sl_step_s = bench_stateless()
+    print(f"YSB: {ysb_tps/1e6:.2f} M tuples/s ({ysb_step_s*1e3:.2f} ms/step, "
+          f"batch={BATCH})", file=sys.stderr)
+    print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "
+          f"({sl_step_s*1e3:.2f} ms/step)", file=sys.stderr)
+    print(f"window-result latency bound ~= step time: {ysb_step_s*1e3:.2f} ms",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "YSB tuples/sec/chip",
+        "value": round(ysb_tps),
+        "unit": "tuples/s",
+        "vs_baseline": round(ysb_tps / BASELINE_TPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

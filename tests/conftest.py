@@ -2,12 +2,20 @@
 
 Multi-chip hardware is not available in CI; sharding correctness is validated on
 ``xla_force_host_platform_device_count=8`` CPU devices (same XLA partitioner as TPU).
-Must run before the first ``import jax`` in any test module.
+
+The session environment pins JAX_PLATFORMS to the single real TPU chip and a
+sitecustomize pre-imports jax, so plain env manipulation is too late — instead force
+the platform through jax.config before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, "tests need the 8-device virtual CPU mesh"

@@ -1,0 +1,123 @@
+"""PipeGraph DAG tests — the graph_test/merge_test/split_test suites' semantics:
+split/merge topologies with randomized geometry, self-checking via sink sums
+(src/graph_test/test_graph_1.cpp ASCII-art topologies + global_sum oracle)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+from windflow_tpu.runtime.pipegraph import PipeGraph
+from windflow_tpu.runtime.builders import (Source_Builder, Map_Builder,
+                                           Filter_Builder, Sink_Builder,
+                                           ReduceSink_Builder, KeyFarm_Builder)
+
+
+def test_linear_graph_with_builders():
+    total = 500
+    src = (Source_Builder(lambda i: {"v": i.astype(jnp.int32)})
+           .withName("src").withTotal(total).withKeys(4).build())
+    m = Map_Builder(lambda t: {"v": t.v * 3}).withName("triple").build()
+    f = Filter_Builder(lambda t: t.v % 2 == 0).withName("evens").build()
+    rs = ReduceSink_Builder(lambda t: t.v).withName("total").build()
+
+    g = PipeGraph("linear", batch_size=128)
+    g.add_source(src).chain(m).chain(f).add(rs)
+    res = g.run()
+    expect = sum(i * 3 for i in range(total) if (i * 3) % 2 == 0)
+    assert int(res["total"]) == expect
+
+
+def test_split_two_branches():
+    """Split by predicate; each branch applies a different map; sums must partition."""
+    total = 400
+    g = PipeGraph("split", batch_size=64)
+    src = wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total)
+    mp = g.add_source(src)
+    mp.split(lambda t: (t.v % 2).astype(jnp.int32), 2)
+    b0 = mp.select(0).add(wf.ReduceSink(lambda t: t.v, name="evens"))
+    b1 = mp.select(1).add(wf.ReduceSink(lambda t: t.v, name="odds"))
+    res = g.run()
+    assert int(res["evens"]) == sum(i for i in range(total) if i % 2 == 0)
+    assert int(res["odds"]) == sum(i for i in range(total) if i % 2 == 1)
+
+
+def test_split_multicast_mask():
+    """Splitting function returning a boolean mask multicasts tuples to branches."""
+    total = 100
+    g = PipeGraph("mcast", batch_size=32)
+    src = wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total)
+    mp = g.add_source(src)
+    mp.split(lambda t: jnp.stack([t.v % 2 == 0, t.v % 3 == 0]), 2)
+    mp.select(0).add(wf.ReduceSink(lambda t: jnp.ones((), jnp.int32), name="n2"))
+    mp.select(1).add(wf.ReduceSink(lambda t: jnp.ones((), jnp.int32), name="n3"))
+    res = g.run()
+    assert int(res["n2"]) == len([i for i in range(total) if i % 2 == 0])
+    assert int(res["n3"]) == len([i for i in range(total) if i % 3 == 0])
+
+
+def test_merge_independent_sources():
+    """merge-ind case (wf/pipegraph.hpp:860-889): two root pipes merged into one."""
+    g = PipeGraph("merge", batch_size=50)
+    s1 = wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=100, name="s1")
+    s2 = wf.Source(lambda i: {"v": (i + 1000).astype(jnp.int32)}, total=100, name="s2")
+    mp1 = g.add_source(s1)
+    mp2 = g.add_source(s2)
+    merged = mp1.merge(mp2)
+    merged.add(wf.ReduceSink(lambda t: t.v, name="sum"))
+    res = g.run()
+    assert int(res["sum"]) == sum(range(100)) + sum(range(1000, 1100))
+
+
+def test_split_then_merge_diamond():
+    """Diamond: source -> split -> two maps -> merge -> sink (graph_test shape)."""
+    total = 200
+    g = PipeGraph("diamond", batch_size=64)
+    src = wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total)
+    mp = g.add_source(src)
+    mp.split(lambda t: (t.v % 2).astype(jnp.int32), 2)
+    b0 = mp.select(0).add(wf.Map(lambda t: {"v": t.v * 10}, name="m0"))
+    b1 = mp.select(1).add(wf.Map(lambda t: {"v": t.v * 100}, name="m1"))
+    merged = b0.merge(b1)
+    merged.add(wf.ReduceSink(lambda t: t.v, name="sum"))
+    res = g.run()
+    expect = sum(i * 10 for i in range(total) if i % 2 == 0) + \
+        sum(i * 100 for i in range(total) if i % 2 == 1)
+    assert int(res["sum"]) == expect
+
+
+def test_windowed_op_in_graph_with_flush():
+    """Windowed operator inside a PipeGraph: EOS flush cascades to the sink."""
+    total, K = 120, 2
+    g = PipeGraph("win", batch_size=40)
+    src = wf.Source(lambda i: {"v": (i // K).astype(jnp.float32)},
+                    total=total, num_keys=K)
+    kf = (KeyFarm_Builder(lambda wid, it: it.sum("v"))
+          .withCBWindows(10, 10).withKeys(K).withName("kf").build())
+    got = []
+
+    def cb(view):
+        if view is None:
+            return
+        got.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                       np.asarray(view["payload"]).tolist()))
+
+    g.add_source(src).add(kf).add_sink(wf.Sink(cb, name="sink"))
+    g.run()
+    expect = []
+    for k in range(K):
+        vals = [float(i // K) for i in range(total) if i % K == k]
+        for w in range((len(vals) - 1) // 10 + 1):
+            expect.append((k, w, sum(vals[w * 10:(w + 1) * 10])))
+    assert sorted(got) == sorted(expect)
+
+
+def test_dot_dump_and_introspection():
+    g = PipeGraph("dotg", batch_size=32)
+    src = wf.Source(lambda i: {"v": i * 1.0}, total=64, name="gen")
+    mp = g.add_source(src)
+    mp.add(wf.Map(lambda t: {"v": t.v}, name="id"))
+    mp.add_sink(wf.Sink(lambda v: None, name="sk"))
+    dot = g.dump_DOTGraph()
+    assert "digraph PipeGraph" in dot and "gen" in dot
+    assert len(g.listOperators()) == 3
+    assert g.getNumThreads() == 3

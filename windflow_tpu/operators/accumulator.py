@@ -50,8 +50,8 @@ class Accumulator(Basic_Operator):
     def init_state(self, payload_spec: Any):
         val = jax.eval_shape(self.value_fn, _ref_spec(payload_spec))
         return jax.tree.map(
-            lambda s: jnp.full((self.num_keys,) + s.shape, self.init_value, s.dtype),
-            val)
+            lambda s: jnp.broadcast_to(jnp.asarray(self.init_value, s.dtype),
+                                       (self.num_keys,) + s.shape).copy(), val)
 
     def out_spec(self, payload_spec: Any) -> Any:
         return jax.eval_shape(self.value_fn, _ref_spec(payload_spec))

@@ -73,7 +73,9 @@ class ReduceSink(Basic_Operator):
     def init_state(self, payload_spec: Any):
         from .accumulator import _ref_spec
         val = jax.eval_shape(self.value_fn, _ref_spec(payload_spec))
-        return jax.tree.map(lambda s: jnp.full(s.shape, self.identity, s.dtype), val)
+        return jax.tree.map(
+            lambda s: jnp.broadcast_to(jnp.asarray(self.identity, s.dtype),
+                                       s.shape).copy(), val)
 
     def apply(self, state, batch: Batch):
         vals = jax.vmap(self.value_fn)(tuple_refs(batch))

@@ -83,8 +83,12 @@ class Win_SeqFFAT(Basic_Operator):
             # one batch on a single key touches at most C/pane_len + 1 new panes
             self.P = _next_pow2(self.wpanes + batch_capacity // self.pane_len + 2)
         else:
-            # TB: panes indexed by ts; hold two batches' worth of distinct panes
-            self.P = _next_pow2(self.wpanes + 2 * batch_capacity + 2)
+            # TB: panes indexed by ts//pane_len; a batch touches at most
+            # ts_span/pane_len distinct panes — bounded by C but normally far fewer.
+            # Default to C/pane_len + window span (override with pane_capacity for
+            # very bursty timestamp distributions).
+            self.P = _next_pow2(self.wpanes
+                                + max(64, batch_capacity // self.pane_len) + 2)
 
     def out_capacity(self, in_capacity: int) -> int:
         return self._resolve_w(in_capacity)
@@ -102,7 +106,9 @@ class Win_SeqFFAT(Basic_Operator):
         agg = self._lift_spec(payload_spec)
         return FFATState(
             panes=jax.tree.map(
-                lambda s: jnp.full((K, P) + s.shape, self.identity, s.dtype), agg),
+                lambda s: jnp.broadcast_to(
+                    jnp.asarray(self.identity, s.dtype),
+                    (K, P) + s.shape).copy(), agg),
             pane_count=jnp.zeros((K, P), CTRL_DTYPE),
             pane_of=jnp.full((K, P), -1, CTRL_DTYPE),
             count=jnp.zeros((K,), CTRL_DTYPE),

@@ -60,6 +60,18 @@ def segment_reduce(values: Any, keys: jax.Array, valid: jax.Array, num_keys: int
             v = jnp.where(_bmask(valid, v), v, 0)
             return jax.ops.segment_sum(v, keys, num_segments=num_keys)
         return jax.tree.map(red, values)
+    # scatter-combine fast paths (XLA scatter-max/min — no sort)
+    if combine in (jnp.maximum, jnp.minimum):
+        seg = jax.ops.segment_max if combine is jnp.maximum else jax.ops.segment_min
+        def red(v):
+            v = jnp.where(_bmask(valid, v), v, jnp.asarray(identity, v.dtype))
+            out = seg(v, keys, num_segments=num_keys)
+            # untouched segments come back as the dtype's +-inf/min; reset to identity
+            touched = jax.ops.segment_sum(valid.astype(jnp.int32), keys,
+                                          num_segments=num_keys) > 0
+            return jnp.where(_bmask(touched, out), out,
+                             jnp.asarray(identity, v.dtype))
+        return jax.tree.map(red, values)
     scanned, order, seg_keys, seg_valid = _sorted_segment_scan(
         values, keys, valid, combine, identity)
     # last live position of each segment: where the next sorted key differs
@@ -69,7 +81,7 @@ def segment_reduce(values: Any, keys: jax.Array, valid: jax.Array, num_keys: int
 
     def scatter(v):
         shape = (num_keys + 1,) + v.shape[1:]
-        init = jnp.full(shape, identity, v.dtype)
+        init = jnp.broadcast_to(jnp.asarray(identity, v.dtype), shape)
         return init.at[out_idx].set(v, mode="drop")[:num_keys]
     return jax.tree.map(scatter, scanned)
 

@@ -1,0 +1,12 @@
+from .mesh import make_mesh, make_mesh_2d, leading_axis_sharding, replicated
+from .sharding import ShardedChain, shard_batch, batch_sharding
+from .emitters import (Basic_Emitter, Standard_Emitter, Broadcast_Emitter,
+                       Splitting_Emitter, Tree_Emitter)
+from .ordering import Ordering_Node
+
+__all__ = [
+    "make_mesh", "make_mesh_2d", "leading_axis_sharding", "replicated",
+    "ShardedChain", "shard_batch", "batch_sharding",
+    "Basic_Emitter", "Standard_Emitter", "Broadcast_Emitter",
+    "Splitting_Emitter", "Tree_Emitter", "Ordering_Node",
+]

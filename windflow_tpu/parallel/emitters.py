@@ -1,0 +1,121 @@
+"""Emitters — batch-level routing between pipeline segments (reference L2).
+
+The reference's emitters scatter *tuples* to replica queues; here they scatter whole
+micro-batches (or partition one batch into per-destination sub-batches) between
+compiled segments — used by the threaded host runtime and multi-program topologies.
+All partitioning math runs on device (jitted), host code only moves batch handles.
+
+- :class:`Standard_Emitter` — FORWARD / KEYBY (``wf/standard_emitter.hpp:42-132``):
+  KEYBY partitions a batch by ``hash(key) % n_dest`` into n_dest sub-batches via the
+  sort-based compaction the reference's own scattering study favors
+  (``wf/standard_nodes_gpu.hpp:52-238``, ``results_scattering.org``).
+- :class:`Broadcast_Emitter` — copy-to-all (``wf/broadcast_emitter.hpp:42-110``); no
+  refcounted wrapper needed: JAX arrays are immutable and shared.
+- :class:`Splitting_Emitter` — user split function routes tuples to branches
+  (``wf/splitting_emitter.hpp:41-152``); masks, optionally multicast.
+- :class:`Tree_Emitter` — two-level composition: root emitter then per-destination
+  child emitters (``wf/tree_emitter.hpp:42-229``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t
+from ..batch import Batch, tuple_refs
+from ..ops.compaction import partition_by_destination
+
+
+class Basic_Emitter:
+    """Pluggable routing node (``wf/basic_emitter.hpp:40-57``): maps one input batch
+    to a list of (destination, batch) pairs."""
+
+    def __init__(self, n_dest: int):
+        self.n_dest = int(n_dest)
+
+    def getNDestinations(self) -> int:
+        return self.n_dest
+
+    def clone(self) -> "Basic_Emitter":
+        import copy
+        return copy.copy(self)
+
+    def route(self, batch: Batch) -> List[Batch]:
+        raise NotImplementedError
+
+
+class Standard_Emitter(Basic_Emitter):
+    def __init__(self, n_dest: int, mode: routing_modes_t = routing_modes_t.FORWARD,
+                 routing_func: Callable = None, capacity_per_dest: int = None):
+        super().__init__(n_dest)
+        self.mode = mode
+        self.routing_func = routing_func or (lambda h, n: h % n)
+        self.capacity_per_dest = capacity_per_dest
+        self._rr = 0
+        self._jit_part = jax.jit(self._partition, static_argnums=(1,))
+
+    def _partition(self, batch: Batch, cap: int):
+        dest = self.routing_func(batch.key, self.n_dest).astype(jnp.int32)
+        idx, ov = partition_by_destination(dest, batch.valid, self.n_dest, cap)
+        return [batch.select(idx[d], ov[d]) for d in range(self.n_dest)]
+
+    def route(self, batch: Batch) -> List[Optional[Batch]]:
+        if self.mode == routing_modes_t.KEYBY:
+            cap = self.capacity_per_dest or batch.capacity
+            return self._jit_part(batch, cap)
+        # FORWARD: round-robin whole batches (reference sends tuples round-robin;
+        # batch granularity keeps device work contiguous)
+        out = [None] * self.n_dest
+        out[self._rr % self.n_dest] = batch
+        self._rr += 1
+        return out
+
+
+class Broadcast_Emitter(Basic_Emitter):
+    def route(self, batch: Batch) -> List[Batch]:
+        return [batch] * self.n_dest
+
+
+class Splitting_Emitter(Basic_Emitter):
+    def __init__(self, split_fn: Callable, n_dest: int):
+        super().__init__(n_dest)
+        self.split_fn = split_fn
+        self._jit_sel = jax.jit(self._select)
+
+    def _select(self, batch: Batch):
+        sel = jax.vmap(self.split_fn)(tuple_refs(batch))
+        outs = []
+        for i in range(self.n_dest):
+            if getattr(sel, "ndim", 1) == 2:
+                keep = sel[:, i].astype(jnp.bool_)
+            else:
+                keep = jnp.asarray(sel, jnp.int32) == i
+            outs.append(batch.mask(keep))
+        return outs
+
+    def route(self, batch: Batch) -> List[Batch]:
+        return self._jit_sel(batch)
+
+
+class Tree_Emitter(Basic_Emitter):
+    """Root emitter fans to child emitters; destination j of child i is global
+    destination ``sum(n_dest of children < i) + j`` (``wf/tree_emitter.hpp``)."""
+
+    def __init__(self, root: Basic_Emitter, children: Sequence[Basic_Emitter]):
+        if root.getNDestinations() != len(children):
+            raise ValueError("root destinations must equal number of children")
+        super().__init__(sum(c.getNDestinations() for c in children))
+        self.root = root
+        self.children = [c.clone() for c in children]
+
+    def route(self, batch: Batch) -> List[Optional[Batch]]:
+        out: List[Optional[Batch]] = []
+        for child, b in zip(self.children, self.root.route(batch)):
+            if b is None:
+                out.extend([None] * child.getNDestinations())
+            else:
+                out.extend(child.route(b))
+        return out

@@ -1,0 +1,47 @@
+"""Device-mesh helpers — the scale-out substrate.
+
+The reference scales inside one shared-memory process with FastFlow threads; the
+TPU-native generalization is a ``jax.sharding.Mesh`` over chips with named axes and
+XLA-inserted collectives over ICI (SURVEY §2.6, §5). Axis vocabulary:
+
+- ``"dp"``   — data parallelism: the micro-batch capacity axis (operator replication,
+  reference ``parallelism`` of every operator).
+- ``"key"``  — key partitioning: the [K] state-table axis (KF_Emitter whole-key
+  routing, ``wf/kf_nodes.hpp:74-90``).
+- ``"win"``  — window parallelism: the [W] fired-window axis (WF_Emitter round-robin
+  window ownership, ``wf/wf_nodes.hpp:182-204``).
+- ``"part"`` — intra-window partitioning (Win_MapReduce MAP stage,
+  ``wf/wm_nodes.hpp:45-181``) — combines over ICI with psum.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "dp",
+              devices: Sequence = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` (default: all)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def make_mesh_2d(shape, axes=("dp", "key"), devices: Sequence = None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    n = shape[0] * shape[1]
+    return Mesh(np.array(devs[:n]).reshape(shape), tuple(axes))
+
+
+def leading_axis_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    """Shard the leading array axis over mesh axis ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,94 @@
+"""Ordering — deterministic order restoration at merge/shuffle boundaries.
+
+Counterpart of ``Ordering_Node`` (``wf/ordering_node.hpp:47-287``): the reference
+buffers tuples per key in priority queues and releases those at or below the
+*low-watermark* — the minimum over all input channels of the maximum id/ts seen
+(``maxs[]`` logic, ``:79-94``). The batch-level restatement:
+
+- each input channel advances a watermark = max (ts or id) of the batches it has
+  delivered;
+- buffered batches are merged, stably sorted by (ts, id) (or (id,)), and the prefix
+  with sort-key <= min(channel watermarks) is released; the rest is retained.
+
+Modes mirror ``ordering_mode_t`` (``wf/basic.hpp:129``): ID, TS, TS_RENUMBERING
+(released tuples are renumbered with a progressive id — used by DETERMINISTIC
+count-based windows downstream, ``wf/pipegraph.hpp:1954-1957``).
+
+The merge-sort-release kernel is jitted; the host side only tracks watermarks.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import ordering_mode_t
+from ..batch import Batch, CTRL_DTYPE, concat_batches
+
+
+class Ordering_Node:
+    def __init__(self, n_inputs: int, mode: ordering_mode_t = ordering_mode_t.TS):
+        self.n_inputs = int(n_inputs)
+        self.mode = mode
+        self._wm = [None] * self.n_inputs        # per-channel high watermark
+        self._pending: Optional[Batch] = None
+        self._next_id = 0
+        self._release_jit = jax.jit(self._release)
+
+    # -- jitted core ------------------------------------------------------------------
+
+    def _sort_key(self, b: Batch):
+        return b.id if self.mode == ordering_mode_t.ID else b.ts
+
+    def _release(self, pending: Batch, low_wm):
+        k = self._sort_key(pending)
+        big = jnp.iinfo(CTRL_DTYPE).max
+        keyv = jnp.where(pending.valid, k, big)
+        order = jnp.argsort(keyv, stable=True)
+        sortedb = pending.select(order, jnp.ones_like(pending.valid))
+        ks = jnp.where(sortedb.valid, self._sort_key(sortedb), big)
+        releasable = ks <= low_wm
+        out = sortedb.mask(releasable)
+        kept = sortedb.mask(sortedb.valid & ~releasable)
+        return out, kept
+
+    # -- host protocol ----------------------------------------------------------------
+
+    def push(self, channel: int, batch: Batch) -> Optional[Batch]:
+        """Deliver a batch from ``channel``; returns a released (ordered) batch or
+        None if nothing can be released yet."""
+        import numpy as np
+        k = np.asarray(self._sort_key(batch))
+        v = np.asarray(batch.valid)
+        if v.any():
+            mx = int(k[v].max())
+            self._wm[channel] = mx if self._wm[channel] is None else max(
+                self._wm[channel], mx)
+        self._pending = (batch if self._pending is None
+                         else concat_batches(self._pending, batch))
+        if any(w is None for w in self._wm):
+            return None
+        low = min(self._wm)
+        out, kept = self._release_jit(self._pending, jnp.asarray(low, CTRL_DTYPE))
+        self._pending = kept
+        return self._maybe_renumber(out)
+
+    def flush(self) -> Optional[Batch]:
+        """EOS: release everything, sorted."""
+        if self._pending is None:
+            return None
+        out, _ = self._release_jit(self._pending,
+                                   jnp.asarray(jnp.iinfo(CTRL_DTYPE).max - 1, CTRL_DTYPE))
+        self._pending = None
+        return self._maybe_renumber(out)
+
+    def _maybe_renumber(self, out: Optional[Batch]) -> Optional[Batch]:
+        if out is None or self.mode != ordering_mode_t.TS_RENUMBERING:
+            return out
+        import numpy as np
+        n = int(np.asarray(jnp.sum(out.valid)))
+        ids = jnp.cumsum(out.valid.astype(CTRL_DTYPE)) - 1 + self._next_id
+        self._next_id += n
+        return out.replace(id=jnp.where(out.valid, ids, out.id))

@@ -67,6 +67,7 @@ class MultiPipe:
         self.split_branches: List[MultiPipe] = []
         # merge structure: upstream pipes feeding this one
         self.merge_inputs: List[MultiPipe] = []
+        self._dataflow_parent: Optional[MultiPipe] = None   # split-branch feeder
         self._chain: Optional[CompiledChain] = None
         self._outputs_to: List[MultiPipe] = []
 
@@ -109,7 +110,7 @@ class MultiPipe:
         node = self.graph._node_of(self)
         for _ in range(n_branches):
             child = MultiPipe(self.graph)
-            child.merge_inputs = []  # filled implicitly by split routing
+            child._dataflow_parent = self
             self.split_branches.append(child)
             cn = AppNode(child, node)
             node.children.append(cn)
@@ -162,9 +163,8 @@ class MultiPipe:
             return self.source.payload_spec()
         if self.merge_inputs:
             return self.merge_inputs[0]._out_payload_spec()
-        # split branch: parent's output spec
-        node = self.graph._node_of(self)
-        return node.parent.mp._out_payload_spec()
+        # split branch: the splitting pipe's output spec
+        return self._dataflow_parent._out_payload_spec()
 
     def _out_payload_spec(self):
         spec = self._in_payload_spec()
@@ -304,9 +304,8 @@ class PipeGraph:
             seen.add(id(mp))
             for up in mp.merge_inputs:
                 visit(up)
-            node = self._nodes.get(id(mp))
-            if node and node.parent and node.parent.mp is not mp:
-                visit(node.parent.mp)
+            if mp._dataflow_parent is not None:
+                visit(mp._dataflow_parent)
             order.append(mp)
         for p in self._all_pipes():
             visit(p)
