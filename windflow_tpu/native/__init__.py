@@ -1,0 +1,130 @@
+"""ctypes binding for the native host runtime (SPSC queues + thread pinning).
+
+Builds ``libwfnative.so`` from ``spsc_queue.cpp`` on first import if missing (g++ is
+part of the toolchain); falls back to a pure-Python deque shim when no compiler is
+available so the threaded scheduler still works (correctness first, the native ring is
+the fast path)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections import deque
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libwfnative.so")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.wf_queue_create.restype = ctypes.c_void_p
+    lib.wf_queue_create.argtypes = [ctypes.c_uint64]
+    lib.wf_queue_destroy.argtypes = [ctypes.c_void_p]
+    lib.wf_queue_push.restype = ctypes.c_int
+    lib.wf_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.wf_queue_pop.restype = ctypes.c_int
+    lib.wf_queue_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.wf_queue_push_spin.restype = ctypes.c_int
+    lib.wf_queue_push_spin.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_uint64]
+    lib.wf_queue_pop_spin.restype = ctypes.c_int
+    lib.wf_queue_pop_spin.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.c_uint64, ctypes.c_uint64]
+    lib.wf_queue_size.restype = ctypes.c_uint64
+    lib.wf_queue_size.argtypes = [ctypes.c_void_p]
+    lib.wf_pin_thread.restype = ctypes.c_int
+    lib.wf_pin_thread.argtypes = [ctypes.c_int]
+    lib.wf_hardware_concurrency.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+class SPSCQueue:
+    """Bounded SPSC queue of Python objects backed by the native ring: the ring moves
+    opaque uint64 tokens; a side table maps tokens to objects (batch handles). The
+    token table is written only by the producer and cleared only by the consumer —
+    the SPSC discipline keeps it race-free without locks."""
+
+    def __init__(self, capacity: int = 1024):
+        lib = _load()
+        self._lib = lib
+        self._objs = {}
+        self._seq = 0
+        if lib is not None:
+            self._q = lib.wf_queue_create(capacity)
+        else:                               # pure-Python fallback
+            self._q = None
+            self._dq = deque()
+            self._cap = capacity
+            self._cv = threading.Condition()
+
+    def push(self, obj, spin: int = 1024) -> None:
+        if self._q is not None:
+            self._seq += 1
+            tok = self._seq
+            self._objs[tok] = obj
+            self._lib.wf_queue_push_spin(self._q, tok, spin)
+        else:
+            with self._cv:
+                while len(self._dq) >= self._cap:
+                    self._cv.wait(0.001)
+                self._dq.append(obj)
+                self._cv.notify_all()
+
+    def pop(self, spin: int = 1024, max_yields: int = 1 << 20):
+        """Returns (ok, obj)."""
+        if self._q is not None:
+            tok = ctypes.c_uint64()
+            ok = self._lib.wf_queue_pop_spin(self._q, ctypes.byref(tok),
+                                             spin, max_yields)
+            if not ok:
+                return False, None
+            return True, self._objs.pop(tok.value)
+        with self._cv:
+            while not self._dq:
+                if not self._cv.wait(1.0):
+                    return False, None
+            obj = self._dq.popleft()
+            self._cv.notify_all()
+            return True, obj
+
+    def size(self) -> int:
+        if self._q is not None:
+            return int(self._lib.wf_queue_size(self._q))
+        return len(self._dq)
+
+    def __del__(self):
+        if getattr(self, "_q", None) is not None and self._lib is not None:
+            self._lib.wf_queue_destroy(self._q)
+            self._q = None
+
+
+def pin_thread(core: int) -> bool:
+    lib = _load()
+    return lib is not None and lib.wf_pin_thread(core) == 0
+
+
+def hardware_concurrency() -> int:
+    lib = _load()
+    return lib.wf_hardware_concurrency() if lib is not None else (os.cpu_count() or 1)
+
+
+def native_available() -> bool:
+    return _load() is not None

@@ -1,0 +1,126 @@
+"""Threaded pipeline-parallel scheduler over the native SPSC runtime.
+
+The reference runs ONE OS THREAD PER NODE connected by FastFlow lock-free queues
+(``ff_pipeline::run()``, ``wf/pipegraph.hpp:1522-1533``); on TPU the per-*operator*
+thread model would serialize on the single device queue, so the threaded scheduler
+parallelizes at the *segment* level: each pipeline segment (a compiled chain) gets a
+host thread that pops micro-batch handles from its input SPSC ring, dispatches its
+device program (async — the device pipelines across segments), and pushes the output
+handle downstream. The source thread generates/uploads batches; the sink thread
+consumes results. Host threads overlap Python dispatch of stage i+1 with device
+execution of stage i — the ``was_batch_started`` double-buffering of the reference GPU
+nodes (``wf/map_gpu_node.hpp:224-340``) generalized to the whole pipeline.
+
+Thread pinning mirrors the reference default mapping (one core per stage,
+disable like NO_DEFAULT_MAPPING with ``pin=False``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+from ..basic import DEFAULT_BATCH_SIZE
+from ..native import SPSCQueue, pin_thread
+from ..operators.sink import Sink
+from ..operators.source import SourceBase
+from .pipeline import CompiledChain
+
+_EOS = object()
+
+
+class ThreadedPipeline:
+    """Source -> [segment chains...] -> sink, one host thread per stage."""
+
+    def __init__(self, source: SourceBase, segments: Sequence[Sequence],
+                 sink: Optional[Sink] = None, *,
+                 batch_size: int = DEFAULT_BATCH_SIZE,
+                 queue_capacity: int = 8, pin: bool = True):
+        self.source = source
+        self.sink = sink
+        self.batch_size = batch_size
+        self.pin = pin
+        spec = source.payload_spec()
+        self.chains: List[CompiledChain] = []
+        cap = batch_size
+        for seg in segments:
+            chain = CompiledChain(list(seg), spec, batch_capacity=cap)
+            spec = chain.out_spec
+            for op in chain.ops:
+                cap = op.out_capacity(cap)
+            self.chains.append(chain)
+        # queue i feeds chain i; last queue feeds the sink thread
+        self.queues = [SPSCQueue(queue_capacity) for _ in range(len(self.chains) + 1)]
+        self._errors: List[BaseException] = []
+
+    # -- stage bodies -----------------------------------------------------------------
+
+    def _source_body(self, core: int):
+        if self.pin:
+            pin_thread(core)
+        try:
+            for batch in self.source.batches(self.batch_size):
+                self.queues[0].push(batch)
+        except BaseException as e:          # noqa: BLE001 — propagated to join
+            self._errors.append(e)
+        finally:
+            self.queues[0].push(_EOS)
+
+    def _segment_body(self, i: int, core: int):
+        if self.pin:
+            pin_thread(core)
+        chain, q_in, q_out = self.chains[i], self.queues[i], self.queues[i + 1]
+        try:
+            while True:
+                ok, item = q_in.pop()
+                if not ok:
+                    continue
+                if item is _EOS:
+                    for out in chain.flush():
+                        q_out.push(out)
+                    break
+                q_out.push(chain.push(item))
+        except BaseException as e:          # noqa: BLE001
+            self._errors.append(e)
+        finally:
+            q_out.push(_EOS)
+
+    def _sink_body(self, core: int):
+        if self.pin:
+            pin_thread(core)
+        q = self.queues[-1]
+        try:
+            while True:
+                ok, item = q.pop()
+                if not ok:
+                    continue
+                if item is _EOS:
+                    break
+                if self.sink is not None:
+                    self.sink.consume(item)
+            if self.sink is not None:
+                self.sink.consume(None)
+        except BaseException as e:          # noqa: BLE001
+            self._errors.append(e)
+
+    # -- run --------------------------------------------------------------------------
+
+    def run(self):
+        threads = [threading.Thread(target=self._source_body, args=(0,),
+                                    name="wf-source")]
+        for i in range(len(self.chains)):
+            threads.append(threading.Thread(target=self._segment_body,
+                                            args=(i, i + 1), name=f"wf-seg{i}"))
+        threads.append(threading.Thread(target=self._sink_body,
+                                        args=(len(self.chains) + 1,),
+                                        name="wf-sink"))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if self._errors:
+            raise self._errors[0]
+        res = {}
+        for c in self.chains:
+            res.update(c.result())
+        return res
