@@ -49,8 +49,14 @@ def make_ops(num_keys: int = N_CAMPAIGNS, win_len: int = WIN_LEN,
 
     from ..operators.base import Basic_Operator
 
+    from ..operators.map import BatchMap
+    from ..ops.lookup import table_lookup
+
     filt = Filter(lambda t: t.event_type == 0, name="ysb_filter")
-    join = Map(lambda t: {"cmp": camp_of[t.ad_id]}, name="ysb_join")
+    # per-tuple campaign join via the gather-free small-table lookup (the reference
+    # joins a hash map per tuple; jnp.take would serialize at ~5.6 ns/tuple)
+    join = BatchMap(lambda p: {"cmp": table_lookup(camp_of, p["ad_id"])},
+                    name="ysb_join")
 
     # Key routing: the window op keys on campaign id; re-key the batch in a tiny
     # projection op that rewrites the control key field (KEYBY re-route).

@@ -52,6 +52,26 @@ class Map(Basic_Operator):
         return state, batch.with_payload(payload)
 
 
+class BatchMap(Basic_Operator):
+    """Batch-level map: ``fn(payload_pytree_of_[C,...]) -> payload_pytree`` — for
+    transforms best expressed over whole arrays (joins via table lookups, projections,
+    dtype casts). The per-batch analogue of writing a custom MapGPU kernel body."""
+
+    def __init__(self, fn: Callable, *, name: str = "batch_map", parallelism: int = 1):
+        super().__init__(name, parallelism)
+        self.fn = fn
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        def one(spec):
+            return jax.ShapeDtypeStruct((1,) + tuple(spec.shape), spec.dtype)
+        out = jax.eval_shape(self.fn, jax.tree.map(one, payload_spec))
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), out)
+
+    def apply(self, state, batch: Batch):
+        return state, batch.with_payload(self.fn(batch.payload))
+
+
 class KeyedMap(Basic_Operator):
     """Stateful map with a per-key HBM state table.
 

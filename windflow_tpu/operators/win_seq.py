@@ -221,6 +221,11 @@ class Win_Seq(Basic_Operator):
             ids = gat(state.arch_id)
             res_ts = wid * s.slide + (s.win_len - 1)
 
+        if not s.is_cb:
+            # TB: a window with no content never fires in the reference (Triggerer_TB
+            # only triggers on tuples); filter empty windows from the emission
+            valid_w = valid_w & jnp.any(content_mask, axis=1)
+
         it = Iterable(data=data, ids=ids, ts=tss, mask=content_mask)
         if self.incremental:
             results = _fold_windows(self.win_fn, wid, it, self.init_acc)
