@@ -1,0 +1,75 @@
+"""The mp_test matrix, TPU edition: {Win_Seq, Win_Farm, Key_Farm, Key_FFAT,
+Pane_Farm, Win_MapReduce} × {CB, TB} × randomized geometry.
+
+The reference's 36-test mp_test_cpu suite re-runs each topology with random
+parallelism degrees in [1,9] and asserts the sink total is invariant
+(src/graph_test/test_graph_1.cpp:77-87). The TPU analogue of "parallelism degree" is
+execution geometry: batch size and window budgets. Each case runs the same stream
+under randomized geometries and asserts identical window results."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.operators.win_seq import Win_Seq
+from windflow_tpu.operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT,
+                                                 Pane_Farm, Win_MapReduce)
+
+TOTAL, K = 240, 3
+rng = np.random.default_rng(7)
+
+
+def run_case(make_op, batch_size):
+    src = wf.Source(lambda i: {"v": ((i * 13) % 23).astype(jnp.float32)},
+                    total=TOTAL, num_keys=K)
+    results = []
+
+    def cb(view):
+        if view is None:
+            return
+        for k, w, r in zip(view["key"].tolist(), view["id"].tolist(),
+                           np.asarray(view["payload"]).tolist()):
+            results.append((k, w, round(float(r), 3)))
+
+    wf.Pipeline(src, [make_op()], wf.Sink(cb), batch_size=batch_size).run()
+    return sorted(results)
+
+
+CASES = {
+    "win_seq_cb": lambda: Win_Seq(lambda wid, it: it.sum("v"),
+                                  WindowSpec(8, 4, win_type_t.CB), num_keys=K),
+    "win_seq_tb": lambda: Win_Seq(lambda wid, it: it.sum("v"),
+                                  WindowSpec(12, 6, win_type_t.TB), num_keys=K),
+    "win_farm_cb": lambda: Win_Farm(lambda wid, it: it.sum("v"),
+                                    WindowSpec(10, 5, win_type_t.CB),
+                                    parallelism=4, num_keys=K),
+    "key_farm_cb": lambda: Key_Farm(lambda wid, it: it.max("v"),
+                                    WindowSpec(6, 3, win_type_t.CB),
+                                    parallelism=3, num_keys=K),
+    "key_ffat_cb": lambda: Key_FFAT(lambda t: t.v, jnp.add,
+                                    spec=WindowSpec(8, 2, win_type_t.CB),
+                                    num_keys=K),
+    "key_ffat_tb": lambda: Key_FFAT(lambda t: t.v, jnp.add,
+                                    spec=WindowSpec(10, 5, win_type_t.TB),
+                                    num_keys=K),
+    "pane_farm_cb": lambda: Pane_Farm(lambda pid, it: it.sum("v"),
+                                      lambda wid, it: it.sum(),
+                                      WindowSpec(9, 3, win_type_t.CB), num_keys=K),
+    "wmr_cb": lambda: Win_MapReduce(lambda wid, it: it.sum("v"),
+                                    lambda wid, it: it.sum(),
+                                    WindowSpec(8, 8, win_type_t.CB),
+                                    map_parallelism=2, num_keys=K),
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_result_invariance_under_geometry(case):
+    make_op = CASES[case]
+    sizes = sorted(set([int(rng.integers(16, 120)), 60, TOTAL]))
+    runs = [run_case(make_op, bs) for bs in sizes]
+    assert runs[0], f"{case}: produced no windows"
+    for r, bs in zip(runs[1:], sizes[1:]):
+        assert r == runs[0], f"{case}: results differ at batch_size={bs}"

@@ -17,6 +17,21 @@ from .shipper import Shipper
 from .operators import (Basic_Operator, Source, DeviceSource, GeneratorSource,
                         Map, KeyedMap, Filter, FilterMap, Compact, FlatMap,
                         Accumulator, Sink, ReduceSink)
+from .operators.map import BatchMap
+from .operators.window import WindowSpec, Iterable
+from .operators.win_seq import Win_Seq
+from .operators.win_seqffat import Win_SeqFFAT
+from .operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT, Pane_Farm,
+                                     Win_MapReduce)
 from .runtime import CompiledChain, Pipeline, Stats_Record
+from .runtime.pipegraph import PipeGraph, MultiPipe
+from .runtime.threaded import ThreadedPipeline
+from .runtime import builders
+from .runtime.builders import (Source_Builder, Filter_Builder, Map_Builder,
+                               FlatMap_Builder, Accumulator_Builder,
+                               WinSeq_Builder, WinSeqFFAT_Builder,
+                               WinFarm_Builder, KeyFarm_Builder, KeyFFAT_Builder,
+                               PaneFarm_Builder, WinMapReduce_Builder,
+                               Sink_Builder, ReduceSink_Builder)
 
 __version__ = "0.1.0"

@@ -27,6 +27,7 @@ from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
 from ..batch import Batch, tuple_refs, TupleRef
 from ..context import RuntimeContext
 from ..meta import classify_map
+from ..ops.lookup import table_lookup
 from .base import Basic_Operator
 
 
@@ -118,7 +119,7 @@ class KeyedMap(Basic_Operator):
         # (1 key => 0.44-0.64 M t/s, results.org:8,37) — but paid only *within* a
         # batch, not across the whole stream.
         if self.max_key_multiplicity == 1 or not self.ordered:
-            st_k = jax.tree.map(lambda tbl: jnp.take(tbl, batch.key, axis=0), state)
+            st_k = jax.tree.map(lambda tbl: table_lookup(tbl, batch.key), state)
             res, new_st = jax.vmap(self.fn)(refs, st_k)
             safe_key = jnp.where(batch.valid, batch.key, self.num_keys)
             state = jax.tree.map(
@@ -130,7 +131,7 @@ class KeyedMap(Basic_Operator):
         def round_body(r, carry):
             st, out_payload = carry
             active = batch.valid & (rank == r)
-            st_k = jax.tree.map(lambda tbl: jnp.take(tbl, batch.key, axis=0), st)
+            st_k = jax.tree.map(lambda tbl: table_lookup(tbl, batch.key), st)
             res, new_st = jax.vmap(self.fn)(refs, st_k)
             safe_key = jnp.where(active, batch.key, self.num_keys)
             st = jax.tree.map(
@@ -143,7 +144,7 @@ class KeyedMap(Basic_Operator):
 
         out_shape = jax.eval_shape(
             lambda s, b: jax.vmap(self.fn)(
-                tuple_refs(b), jax.tree.map(lambda t: jnp.take(t, b.key, axis=0), s))[0],
+                tuple_refs(b), jax.tree.map(lambda t: table_lookup(t, b.key), s))[0],
             state, batch)
         out0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
         state, out_payload = jax.lax.fori_loop(

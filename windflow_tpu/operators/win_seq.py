@@ -129,14 +129,15 @@ class Win_Seq(Basic_Operator):
     # ------------------------------------------------------------------ insert
 
     def _insert(self, state: WinSeqState, batch: Batch) -> WinSeqState:
+        from ..ops.lookup import table_lookup
         K, A = self.num_keys, self.A
         valid = batch.valid
         if not self.spec.is_cb:
             # drop OLD tuples: they precede the purge horizon (already-fired windows)
-            horizon = jnp.take(state.next_win, batch.key) * self.spec.slide
+            horizon = table_lookup(state.next_win, batch.key) * self.spec.slide
             valid = valid & (batch.ts >= horizon)
         rank = segment_rank(batch.key, valid)
-        pos = jnp.take(state.count, batch.key) + rank
+        pos = table_lookup(state.count, batch.key) + rank
         slot = pos % A
         flat = jnp.where(valid, batch.key * A + slot, K * A)  # OOB -> dropped
 

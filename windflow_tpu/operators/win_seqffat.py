@@ -259,14 +259,15 @@ class Win_SeqFFAT(Basic_Operator):
         'update leaf + bubble' (wf/flatfat.hpp:134-240) collapsed into one segment
         reduction per batch."""
         from ..ops.segment import segment_rank
+        from ..ops.lookup import table_lookup
         K, P = self.num_keys, self.P
         valid = batch.valid
         if self.spec.is_cb:
             rank = segment_rank(batch.key, valid)
-            pos = jnp.take(state.count, batch.key) + rank
+            pos = table_lookup(state.count, batch.key) + rank
             pane = pos // self.pane_len
         else:
-            horizon = jnp.take(state.next_win, batch.key) * self.spec.slide
+            horizon = table_lookup(state.next_win, batch.key) * self.spec.slide
             valid = valid & (batch.ts >= horizon)
             pane = batch.ts // self.pane_len
         slot = pane % P

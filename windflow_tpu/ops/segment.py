@@ -102,8 +102,9 @@ def segment_prefix_scan(values: Any, keys: jax.Array, valid: jax.Array,
     if carry_in is not None:
         # associativity: fold(carry, v1..vr) == combine(carry, fold(v1..vr)), so the
         # per-key carry is applied once, after the in-batch scan
+        from .lookup import table_lookup
         out = jax.tree.map(
-            lambda v, t: combine(jnp.take(t, keys, axis=0), v), out, carry_in)
+            lambda v, t: combine(table_lookup(t, keys), v), out, carry_in)
     return out
 
 

@@ -1,0 +1,49 @@
+"""Checkpoint / resume of pipeline state.
+
+The reference has NO checkpointing (SURVEY §5: all operator state — keyMaps, archives,
+FlatFATs — is in-memory and lost at exit). Here every operator's state is a pytree of
+device arrays threaded through the compiled step, so checkpointing is structural:
+``save_chain`` snapshots each operator's state (plus stream-position metadata) to an
+``.npz``; ``load_chain`` restores it. Works for any CompiledChain (and therefore any
+Pipeline / PipeGraph segment).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from .pipeline import CompiledChain
+
+
+def _flatten(states) -> Dict[str, np.ndarray]:
+    out = {}
+    for i, st in enumerate(states):
+        leaves, _ = jax.tree.flatten(st)
+        for j, leaf in enumerate(leaves):
+            out[f"op{i}_leaf{j}"] = np.asarray(leaf)
+    return out
+
+
+def save_chain(chain: CompiledChain, path: str, *, meta: dict = None) -> None:
+    arrays = _flatten(chain.states)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8)
+    np.savez(path, **arrays)
+
+
+def load_chain(chain: CompiledChain, path: str) -> dict:
+    """Restore states in place; returns the saved metadata dict."""
+    data = np.load(path)
+    new_states = []
+    for i, st in enumerate(chain.states):
+        leaves, treedef = jax.tree.flatten(st)
+        restored = [jax.numpy.asarray(data[f"op{i}_leaf{j}"])
+                    for j in range(len(leaves))]
+        new_states.append(jax.tree.unflatten(treedef, restored))
+    chain.states = new_states
+    raw = data.get("__meta__")
+    return json.loads(bytes(raw).decode()) if raw is not None else {}
