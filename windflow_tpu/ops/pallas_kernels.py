@@ -1,0 +1,62 @@
+"""Pallas TPU kernels for dense hot ops.
+
+Scope note (measured; see docs/ARCHITECTURE.md §5): the framework's irregular ops —
+scatter-add pane folds, dynamic gathers — are NOT expressible efficiently in Mosaic
+(dynamic VMEM indexing must be provably tile-aligned; a random-index store fails with
+"cannot statically prove that index ... is a multiple of 1024"), and XLA's scatter
+emitter is the fastest available path. Pallas is used where its tiling model fits:
+dense batched reductions over the fired-window axis — the compute inside the
+reference GPU engine's ``ComputeBatch_Kernel`` (one thread per window,
+``wf/win_seq_gpu.hpp:57-82``), here one *tile row* per window.
+
+``masked_window_reduce``: given window contents ``[W, L]`` + occupancy mask, produce
+per-window sums — the hot aggregation of Win_Seq non-incremental sum windows. Falls
+back to the XLA formulation off-TPU (and under ``interpret=True`` in tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    HAVE_PALLAS = True
+except Exception:                                     # pragma: no cover
+    HAVE_PALLAS = False
+
+#: row-tile height per grid step (W axis); L is processed whole per row-tile.
+ROW_TILE = 256
+
+
+def _reduce_kernel(vals_ref, mask_ref, out_ref):
+    v = vals_ref[...]
+    m = mask_ref[...]
+    out_ref[...] = jnp.sum(jnp.where(m, v, jnp.zeros_like(v)), axis=1)
+
+
+def _xla_masked_sum(vals, mask):
+    return jnp.sum(jnp.where(mask, vals, jnp.zeros_like(vals)), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_window_reduce(vals: jax.Array, mask: jax.Array, *,
+                         interpret: bool = False) -> jax.Array:
+    """Per-window masked sum of ``vals [W, L]`` under ``mask [W, L]`` -> ``[W]``."""
+    W, L = vals.shape
+    if not HAVE_PALLAS or W % ROW_TILE or L % 128:
+        return _xla_masked_sum(vals, mask)
+    try:
+        return pl.pallas_call(
+            _reduce_kernel,
+            grid=(W // ROW_TILE,),
+            in_specs=[pl.BlockSpec((ROW_TILE, L), lambda i: (i, 0)),
+                      pl.BlockSpec((ROW_TILE, L), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((ROW_TILE,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((W,), vals.dtype),
+            interpret=interpret,
+        )(vals, mask)
+    except Exception:                                  # lowering unsupported: fall back
+        return _xla_masked_sum(vals, mask)

@@ -207,12 +207,118 @@ class PipeGraph:
         self._nodes[id(mp)] = node
         return mp
 
-    def run(self):
+    def run(self, threaded: bool = False):
+        """Drive the graph to completion. ``threaded=True`` gives each MultiPipe its
+        own host thread connected by native SPSC rings — true pipeline parallelism
+        across segments (the reference's thread-per-node model at segment
+        granularity, ``wf/pipegraph.hpp:1522-1533``)."""
         self.start()
+        if threaded:
+            return self._run_threaded()
         return self.wait_end()
 
     def start(self):
         self._started = True
+
+    # -- threaded driver --------------------------------------------------------------
+
+    def _run_threaded(self):
+        import threading
+        from ..native import SPSCQueue
+
+        pipes = self._all_pipes()
+        EOS = object()
+        # one SPSC ring per dataflow EDGE (single producer, single consumer); a
+        # consumer with several inputs (merge) polls its rings round-robin
+        in_queues = {id(p): [] for p in pipes}
+        out_edges = {}                           # (producer id, consumer id) -> queue
+
+        def add_edge(src_id, dst):
+            q = SPSCQueue(8)
+            in_queues[id(dst)].append(q)
+            out_edges[(src_id, id(dst))] = q
+            return q
+
+        for p in pipes:
+            if p.source is not None:
+                add_edge("src", p)
+            for b in p.split_branches:
+                add_edge(id(p), b)
+            for m in p._outputs_to:
+                add_edge(id(p), m)
+        errors = []
+
+        def deliver(mp, out):
+            if mp.sink is not None:
+                mp.sink.consume(out)
+            if mp.split_fn is not None:
+                sel = jax.vmap(mp.split_fn)(tuple_refs(out))
+                for i, branch in enumerate(mp.split_branches):
+                    if getattr(sel, "ndim", 1) == 2:
+                        keep = sel[:, i].astype(jnp.bool_)
+                    else:
+                        keep = jnp.asarray(sel, jnp.int32) == i
+                    out_edges[(id(mp), id(branch))].push(out.mask(keep))
+            for merged in mp._outputs_to:
+                b = out
+                if self.mode == Mode.DETERMINISTIC:
+                    b = b.sorted_by(by="ts")
+                out_edges[(id(mp), id(merged))].push(b)
+
+        def propagate_eos(mp):
+            for branch in mp.split_branches:
+                out_edges[(id(mp), id(branch))].push(EOS)
+            for merged in mp._outputs_to:
+                out_edges[(id(mp), id(merged))].push(EOS)
+
+        def pipe_body(mp):
+            try:
+                live = list(in_queues[id(mp)])
+                while live:
+                    for q in list(live):
+                        ok, item = q.pop(spin=64, max_yields=0)
+                        if not ok:
+                            continue
+                        if item is EOS:
+                            live.remove(q)
+                            continue
+                        chain = mp._compile(item.capacity)
+                        deliver(mp, chain.push(item))
+                if mp._chain is not None:
+                    for out in mp._chain.flush():
+                        deliver(mp, out)
+                if mp.sink is not None:
+                    mp.sink.consume(None)
+            except BaseException as e:          # noqa: BLE001 — re-raised at join
+                errors.append(e)
+            finally:
+                propagate_eos(mp)
+
+        def source_body(mp):
+            q = out_edges[("src", id(mp))]
+            try:
+                for batch in mp.source.batches(self.batch_size):
+                    q.push(batch)
+            except BaseException as e:          # noqa: BLE001
+                errors.append(e)
+            finally:
+                q.push(EOS)
+
+        threads = []
+        for p in pipes:
+            threads.append(threading.Thread(target=pipe_body, args=(p,),
+                                            name=f"wf-pipe-{id(p) % 1000}"))
+        for p in self._roots:
+            threads.append(threading.Thread(target=source_body, args=(p,),
+                                            name="wf-src"))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        self._ended = True
+        return self._results()
 
     def wait_end(self):
         """Drive the whole DAG to completion (the reference joins threads here,
@@ -247,6 +353,15 @@ class PipeGraph:
 
     def listOperators(self) -> List[Basic_Operator]:
         return list(self._operators)
+
+    def dump_stats(self, log_dir: str = "log"):
+        """Dump every operator's Stats_Record to ``log/`` (TRACE_WINDFLOW analogue,
+        ``wf/stats_record.hpp:109-155``). Returns the written paths."""
+        paths = []
+        for op in self._operators:
+            for rec in op.get_StatsRecords():
+                paths.append(rec.dump_to_file(log_dir))
+        return paths
 
     def dump_DOTGraph(self, path: str = None) -> str:
         """Graphviz dump (GRAPHVIZ_WINDFLOW, wf/pipegraph.hpp:226-237,1450-1518)."""
