@@ -7,6 +7,11 @@ recovered with segment operations. The reference's own GPU scattering study foun
 sort-by-key the winning strategy at high fan-out
 (``src/GPU_Tests/scattering/results_scattering.org``) — which is exactly the plan here.
 
+TPU cost discipline (docs/ARCHITECTURE.md §5): permutation gathers cost ~5.6 ns/elem,
+so sorting carries companion arrays through multi-operand ``lax.sort`` (one fused sort,
+no ``take(order)``), per-key bases come from scatter-min first-occurrence + small-table
+lookups, and results return to stream order with a single scatter.
+
 All functions are mask-aware: invalid lanes contribute the combine identity.
 """
 
@@ -23,22 +28,85 @@ def _bmask(valid, v):
     return valid.reshape(valid.shape + (1,) * (v.ndim - 1))
 
 
-def _sorted_segment_scan(values, keys, valid, combine, identity):
-    """Stable sort by (invalid, key), then segmented inclusive associative scan.
+def _sort_by_key(keys, valid, arrays):
+    """Stable multi-operand sort by (invalid, key): returns
+    (sorted_key_or_max, original_index, sorted arrays...). One fused sort — the
+    companion arrays ride along instead of being permutation-gathered afterwards."""
+    big = jnp.iinfo(keys.dtype).max
+    sort_key = jnp.where(valid, keys, big)
+    iota = jnp.arange(keys.shape[0], dtype=jnp.int32)
+    flat, treedef = jax.tree.flatten(arrays)
+    out = jax.lax.sort((sort_key, iota, *flat), num_keys=1, is_stable=True)
+    return out[0], out[1], jax.tree.unflatten(treedef, out[2:])
 
-    Returns (scanned values in sorted order, sort order, sorted keys, sorted valid)."""
-    sort_key = jnp.where(valid, keys, jnp.iinfo(keys.dtype).max)
-    order = jnp.argsort(sort_key, stable=True)
-    seg_keys = jnp.take(sort_key, order)
-    seg_valid = jnp.take(valid, order)
-    sv = jax.tree.map(lambda v: jnp.take(v, order, axis=0), values)
+
+def segment_rank(keys: jax.Array, valid: jax.Array) -> jax.Array:
+    """Rank of each live lane among live lanes with the same key (0-based), in stream
+    order. Sort-pairs + first-occurrence subtraction; one sort, one scatter-min, one
+    small-table lookup, one scatter back to stream order."""
+    c = keys.shape[0]
+    # rank only needs segment grouping: sort (key, index) pairs, segment starts from
+    # boundaries, propagate the start index with a cummax, subtract
+    sorted_keys, orig_idx, _ = _sort_by_key(keys, valid, ())
+    iota = jnp.arange(c, dtype=jnp.int32)
+    starts = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                              sorted_keys[1:] != sorted_keys[:-1]])
+    seg_start_idx = jax.lax.cummax(jnp.where(starts, iota, 0))
+    rank_sorted = iota - seg_start_idx
+    # back to stream order with one scatter
+    return jnp.zeros((c,), jnp.int32).at[orig_idx].set(rank_sorted)
+
+
+def segment_reduce(values: Any, keys: jax.Array, valid: jax.Array, num_keys: int,
+                   combine: Callable = None, identity=0) -> Any:
+    """Per-key reduction of a batch: returns a pytree of ``[num_keys, ...]`` arrays.
+
+    Default combine is addition (lowered to ``segment_sum``); max/min use scatter
+    fast paths; a custom associative ``combine`` uses sort + segmented scan."""
+    if combine is None:
+        def red(v):
+            v = jnp.where(_bmask(valid, v), v, 0)
+            return jax.ops.segment_sum(v, keys, num_segments=num_keys)
+        return jax.tree.map(red, values)
+    if combine in (jnp.maximum, jnp.minimum):
+        seg = jax.ops.segment_max if combine is jnp.maximum else jax.ops.segment_min
+        def red(v):
+            v = jnp.where(_bmask(valid, v), v, jnp.asarray(identity, v.dtype))
+            out = seg(v, keys, num_segments=num_keys)
+            touched = jax.ops.segment_sum(valid.astype(jnp.int32), keys,
+                                          num_segments=num_keys) > 0
+            return jnp.where(_bmask(touched, out), out,
+                             jnp.asarray(identity, v.dtype))
+        return jax.tree.map(red, values)
+    # general associative combine: sorted segmented scan, then scatter each segment's
+    # last element into its key row
+    scanned, seg_keys, seg_valid, _ = _sorted_segment_scan(
+        values, keys, valid, combine, identity)
+    nxt = jnp.concatenate([seg_keys[1:], jnp.full((1,), -1, seg_keys.dtype)])
+    is_last = (seg_keys != nxt) & seg_valid
+    out_idx = jnp.where(is_last, jnp.minimum(seg_keys, num_keys), num_keys)
+
+    def scatter(v):
+        shape = (num_keys + 1,) + v.shape[1:]
+        init = jnp.broadcast_to(jnp.asarray(identity, v.dtype), shape)
+        return init.at[out_idx].set(v, mode="drop")[:num_keys]
+    return jax.tree.map(scatter, scanned)
+
+
+def _sorted_segment_scan(values, keys, valid, combine, identity):
+    """Multi-operand sort by key, then segmented inclusive associative scan.
+
+    Returns (scanned values in sorted order, sorted keys, sorted valid,
+    original indices)."""
+    seg_keys, orig_idx, sv = _sort_by_key(keys, valid, values)
+    big = jnp.iinfo(keys.dtype).max
+    seg_valid = seg_keys != big
     sv = jax.tree.map(lambda v: jnp.where(_bmask(seg_valid, v), v,
                                           jnp.asarray(identity, v.dtype)), sv)
-    starts = jnp.concatenate([jnp.ones((1,), jnp.bool_), seg_keys[1:] != seg_keys[:-1]])
+    starts = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                              seg_keys[1:] != seg_keys[:-1]])
 
     def seg_combine(a, b):
-        # flag = True once a segment boundary has been crossed in the combined range;
-        # when b starts its own segment, discard a's contribution.
         a_f, a_v = a
         b_f, b_v = b
         v = jax.tree.map(
@@ -46,44 +114,7 @@ def _sorted_segment_scan(values, keys, valid, combine, identity):
         return (a_f | b_f, v)
 
     _, scanned = jax.lax.associative_scan(seg_combine, (starts, sv), axis=0)
-    return scanned, order, seg_keys, seg_valid
-
-
-def segment_reduce(values: Any, keys: jax.Array, valid: jax.Array, num_keys: int,
-                   combine: Callable = None, identity=0) -> Any:
-    """Per-key reduction of a batch: returns a pytree of ``[num_keys, ...]`` arrays.
-
-    Default combine is addition (lowered to ``segment_sum``); a custom associative
-    ``combine(a, b)`` uses sort-by-key + segmented associative scan."""
-    if combine is None:
-        def red(v):
-            v = jnp.where(_bmask(valid, v), v, 0)
-            return jax.ops.segment_sum(v, keys, num_segments=num_keys)
-        return jax.tree.map(red, values)
-    # scatter-combine fast paths (XLA scatter-max/min — no sort)
-    if combine in (jnp.maximum, jnp.minimum):
-        seg = jax.ops.segment_max if combine is jnp.maximum else jax.ops.segment_min
-        def red(v):
-            v = jnp.where(_bmask(valid, v), v, jnp.asarray(identity, v.dtype))
-            out = seg(v, keys, num_segments=num_keys)
-            # untouched segments come back as the dtype's +-inf/min; reset to identity
-            touched = jax.ops.segment_sum(valid.astype(jnp.int32), keys,
-                                          num_segments=num_keys) > 0
-            return jnp.where(_bmask(touched, out), out,
-                             jnp.asarray(identity, v.dtype))
-        return jax.tree.map(red, values)
-    scanned, order, seg_keys, seg_valid = _sorted_segment_scan(
-        values, keys, valid, combine, identity)
-    # last live position of each segment: where the next sorted key differs
-    nxt = jnp.concatenate([seg_keys[1:], jnp.full((1,), -1, seg_keys.dtype)])
-    is_last = (seg_keys != nxt) & seg_valid
-    out_idx = jnp.where(is_last, seg_keys, num_keys)  # non-lasts go to an overflow row
-
-    def scatter(v):
-        shape = (num_keys + 1,) + v.shape[1:]
-        init = jnp.broadcast_to(jnp.asarray(identity, v.dtype), shape)
-        return init.at[out_idx].set(v, mode="drop")[:num_keys]
-    return jax.tree.map(scatter, scanned)
+    return scanned, seg_keys, seg_valid, orig_idx
 
 
 def segment_prefix_scan(values: Any, keys: jax.Array, valid: jax.Array,
@@ -93,24 +124,37 @@ def segment_prefix_scan(values: Any, keys: jax.Array, valid: jax.Array,
     ``[num_keys, ...]``), returned in original batch positions.
 
     Batched counterpart of the reference Accumulator's per-key rolling reduce
-    (``wf/accumulator.hpp:61``, keyMap ``:103-104``) for associative user combines:
-    stable sort-by-key (stream order preserved within key) + segmented
-    ``associative_scan`` + unsort."""
-    scanned, order, _, _ = _sorted_segment_scan(values, keys, valid, combine, identity)
-    inv = jnp.argsort(order)
-    out = jax.tree.map(lambda v: jnp.take(v, inv, axis=0), scanned)
+    (``wf/accumulator.hpp:61``, keyMap ``:103-104``) for associative user combines.
+    Addition gets a cumsum fast path (segment prefix = cumsum - segment-start base);
+    general combines use the segmented ``associative_scan``."""
+    from .lookup import table_lookup
+    c = keys.shape[0]
+    if combine in (jnp.add,):
+        seg_keys, orig_idx, sv = _sort_by_key(keys, valid, values)
+        big = jnp.iinfo(keys.dtype).max
+        seg_valid = seg_keys != big
+        iota = jnp.arange(c, dtype=jnp.int32)
+        starts = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                  seg_keys[1:] != seg_keys[:-1]])
+        seg_start_idx = jax.lax.cummax(jnp.where(starts, iota, 0))
+
+        def one(v):
+            v = jnp.where(_bmask(seg_valid, v), v, jnp.asarray(identity, v.dtype))
+            cs = jnp.cumsum(v, axis=0)
+            base = jnp.take(cs, jnp.maximum(seg_start_idx - 1, 0), axis=0)
+            base = jnp.where(_bmask(seg_start_idx > 0, base), base,
+                             jnp.zeros_like(base))
+            # subtract the running total up to the lane before the segment start
+            pref = cs - base
+            return jnp.zeros_like(pref).at[orig_idx].set(pref)
+        out = jax.tree.map(one, sv)
+    else:
+        scanned, _, _, orig_idx = _sorted_segment_scan(
+            values, keys, valid, combine, identity)
+        out = jax.tree.map(
+            lambda v: jnp.zeros_like(v).at[orig_idx].set(v), scanned)
     if carry_in is not None:
-        # associativity: fold(carry, v1..vr) == combine(carry, fold(v1..vr)), so the
-        # per-key carry is applied once, after the in-batch scan
-        from .lookup import table_lookup
+        # associativity: fold(carry, v1..vr) == combine(carry, fold(v1..vr))
         out = jax.tree.map(
             lambda v, t: combine(table_lookup(t, keys), v), out, carry_in)
     return out
-
-
-def segment_rank(keys: jax.Array, valid: jax.Array) -> jax.Array:
-    """Rank of each live lane among live lanes with the same key (0-based), in stream
-    order. Used to assign per-key progressive positions (archive slots, CB indices)."""
-    ones = valid.astype(jnp.int32)
-    incl = segment_prefix_scan(ones, keys, valid, jnp.add, 0)
-    return incl - ones  # exclusive
