@@ -155,6 +155,23 @@ class Batch:
         return jax.tree.map(lambda a: np.asarray(a)[v], self.payload)
 
 
+def hash_key_to_slot(key, num_slots: int):
+    """Map arbitrary user keys (strings, large ints, numpy arrays of ints) to key
+    slots in ``[0, num_slots)`` — the reference's ``hash(key) % n`` routing contract
+    (``wf/standard_emitter.hpp:88-99``) applied at ingest time. Deterministic across
+    runs (unlike Python's salted ``hash``)."""
+    if isinstance(key, str):
+        h = 2166136261
+        for ch in key.encode():
+            h = ((h ^ ch) * 16777619) & 0xFFFFFFFF     # FNV-1a
+        return int(h % num_slots)
+    if isinstance(key, (int, np.integer)):
+        return int((int(key) * 2654435761) % (1 << 32) % num_slots)
+    arr = np.asarray(key)
+    return ((arr.astype(np.uint64) * np.uint64(2654435761)) % np.uint64(num_slots)
+            ).astype(np.int32)
+
+
 def concat_batches(a: Batch, b: Batch) -> Batch:
     """Concatenate two batches along the capacity axis (merge primitive)."""
     cat = lambda x, y: jnp.concatenate([x, y], axis=0)

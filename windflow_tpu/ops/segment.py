@@ -36,8 +36,13 @@ def _sort_by_key(keys, valid, arrays):
     sort_key = jnp.where(valid, keys, big)
     iota = jnp.arange(keys.shape[0], dtype=jnp.int32)
     flat, treedef = jax.tree.flatten(arrays)
-    out = jax.lax.sort((sort_key, iota, *flat), num_keys=1, is_stable=True)
-    return out[0], out[1], jax.tree.unflatten(treedef, out[2:])
+    rides = [l for l in flat if l.ndim == 1]       # lax.sort needs equal shapes
+    out = jax.lax.sort((sort_key, iota, *rides), num_keys=1, is_stable=True)
+    sorted_keys, orig_idx = out[0], out[1]
+    it = iter(out[2:])
+    sorted_flat = [next(it) if l.ndim == 1 else jnp.take(l, orig_idx, axis=0)
+                   for l in flat]
+    return sorted_keys, orig_idx, jax.tree.unflatten(treedef, sorted_flat)
 
 
 def segment_rank(keys: jax.Array, valid: jax.Array) -> jax.Array:
