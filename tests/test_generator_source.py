@@ -33,3 +33,84 @@ def test_hash_key_to_slot_strings():
     assert slots[0] == slots[3]          # deterministic
     arr = hash_key_to_slot(np.asarray([10, 11, 10], np.int64), 4)
     assert arr[0] == arr[2] and 0 <= int(arr[1]) < 4
+
+
+def test_hash_key_scalar_array_agree():
+    # the scalar and vectorized paths must route a key identically (one key's
+    # state must never split across slots)
+    for n in (3, 5, 7, 8, 1000):
+        for k in (0, 2, 3, 10, 12345, 2**40 + 7):
+            assert hash_key_to_slot(k, n) == int(
+                hash_key_to_slot(np.asarray([k], np.int64), n)[0]), (k, n)
+    # string scalar vs string array; bytes hash like their utf-8 string
+    arr = hash_key_to_slot(np.asarray(["alpha", "beta"]), 8)
+    assert int(arr[0]) == hash_key_to_slot("alpha", 8)
+    assert int(arr[1]) == hash_key_to_slot("beta", 8)
+    assert hash_key_to_slot(b"alpha", 8) == hash_key_to_slot("alpha", 8)
+    barr = hash_key_to_slot(np.asarray([b"alpha", b"beta"]), 8)
+    assert barr.tolist() == arr.tolist()
+    # float keys are rejected, not truncated
+    import pytest
+    with pytest.raises(TypeError, match="float"):
+        hash_key_to_slot(np.asarray([1.2, 1.9]), 4)
+
+
+def test_generator_source_string_keys():
+    """mp_test *_str parity: string-keyed tuples hashed to slots at ingest."""
+    K = 8
+    names = np.asarray(["alpha", "beta", "gamma", "delta"])
+
+    def gen():
+        for chunk in range(4):
+            n = 32
+            vals = np.ones(n, np.float32)
+            keys = names[np.arange(n) % 4]
+            yield ({"v": vals}, keys, np.arange(n) + chunk * n)
+
+    spec = {"v": jnp.zeros((), jnp.float32)}
+    src = wf.GeneratorSource(gen, spec, num_keys=K, name="ingest_str")
+    acc = wf.Accumulator(lambda t: t.v, num_keys=K)
+    seen = {}
+
+    def cb(view):
+        if view is None:
+            return
+        for k, r in zip(view["key"].tolist(),
+                        np.asarray(view["payload"]).tolist()):
+            seen[k] = max(seen.get(k, 0.0), float(r))
+
+    wf.Pipeline(src, [acc], wf.Sink(cb), batch_size=32).run()
+    # 4 distinct string keys -> at most 4 slots, each accumulating 32 ones
+    assert sum(seen.values()) == 128.0
+    assert len(seen) == len({hash_key_to_slot(s, K) for s in names.tolist()})
+
+
+def test_generator_source_rejects_raw_string_keys():
+    def gen():
+        yield ({"v": np.ones(4, np.float32)}, np.asarray(["a", "b", "a", "b"]),
+               np.arange(4))
+
+    src = wf.GeneratorSource(gen, {"v": jnp.zeros((), jnp.float32)})
+    rsink = wf.ReduceSink(lambda t: jnp.ones((), jnp.int32), name="n")
+    import pytest
+    with pytest.raises(TypeError, match="num_keys"):
+        wf.Pipeline(src, [rsink], batch_size=8).run()
+
+
+def test_nesting_rejects_extra_args():
+    import pytest
+    from windflow_tpu.basic import win_type_t
+    from windflow_tpu.operators.window import WindowSpec
+    from windflow_tpu.runtime.builders import KeyFarm_Builder
+
+    spec = WindowSpec(6, 2, win_type_t.CB)
+    pf = wf.Pane_Farm(lambda p, i: i.sum("v"), lambda w, i: i.sum(), spec,
+                      num_keys=3)
+    with pytest.raises(TypeError, match="nesting accepts only"):
+        wf.Win_Farm(pf, WindowSpec(99, 1, win_type_t.CB), parallelism=2)
+    with pytest.raises(TypeError, match="num_keys"):
+        wf.Key_Farm(pf, num_keys=77)
+    with pytest.raises(TypeError, match="withCB/TBWindows"):
+        KeyFarm_Builder(pf).withCBWindows(10, 10).build()
+    with pytest.raises(TypeError, match="num_keys"):
+        KeyFarm_Builder(pf).withKeys(9).build()     # extras rejected by the ctor

@@ -83,3 +83,69 @@ def test_win_mapreduce_matches_win_seq():
     got = collect(160, 2, wmr)
     oracle = winseq_oracle(160, 2, spec)
     assert got == oracle
+
+
+def test_win_mapreduce_non_divisible():
+    # win_len not a multiple of map_parallelism: round-robin leaves remainders
+    spec = WindowSpec(10, 10, win_type_t.CB)
+    wmr = Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                        spec, map_parallelism=3, num_keys=2)
+    assert collect(200, 2, wmr) == winseq_oracle(200, 2, spec)
+
+
+def test_win_mapreduce_tb():
+    # TB windows: mask-aware round-robin partition over the archive row
+    spec = WindowSpec(8, 8, win_type_t.TB)
+    wmr = Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                        spec, map_parallelism=2, num_keys=2)
+    assert collect(160, 2, wmr) == winseq_oracle(160, 2, spec)
+
+
+def test_win_mapreduce_sliding():
+    spec = WindowSpec(8, 4, win_type_t.CB)
+    wmr = Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                        spec, map_parallelism=4, num_keys=2)
+    assert collect(160, 2, wmr) == winseq_oracle(160, 2, spec)
+
+
+# ---- nesting: WF+PF, WF+WMR, KF+PF, KF+WMR (the reference's mp_test nested matrix)
+
+def _pf(spec, K):
+    return Pane_Farm(lambda pid, it: it.sum("v"), lambda wid, it: it.sum(), spec,
+                     num_keys=K)
+
+
+def _wmr(spec, K, M=2):
+    return Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                         spec, map_parallelism=M, num_keys=K)
+
+
+def test_nested_wf_pf():
+    spec = WindowSpec(6, 2, win_type_t.CB)
+    op = Win_Farm(_pf(spec, 3), parallelism=4)
+    assert op.shard_axis == "window"
+    assert collect(150, 3, op) == winseq_oracle(150, 3, spec)
+
+
+def test_nested_kf_pf_tb():
+    spec = WindowSpec(8, 4, win_type_t.TB)
+    op = Key_Farm(_pf(spec, 2), parallelism=2)
+    assert op.shard_axis == "key"
+    assert collect(160, 2, op) == winseq_oracle(160, 2, spec)
+
+
+def test_nested_wf_wmr():
+    spec = WindowSpec(8, 4, win_type_t.CB)
+    op = Win_Farm(_wmr(spec, 2, M=4), parallelism=2)
+    assert collect(160, 2, op) == winseq_oracle(160, 2, spec)
+
+
+def test_nested_kf_wmr_builder():
+    from windflow_tpu.runtime.builders import (KeyFarm_Builder, WinMapReduce_Builder)
+    spec_args = (6, 3)
+    inner = (WinMapReduce_Builder(lambda wid, it: it.sum("v"),
+                                  lambda wid, it: it.sum())
+             .withCBWindows(*spec_args).withMapParallelism(3).withKeys(2).build())
+    op = KeyFarm_Builder(inner).withParallelism(2).build()
+    spec = WindowSpec(*spec_args, win_type_t.CB)
+    assert collect(150, 2, op) == winseq_oracle(150, 2, spec)

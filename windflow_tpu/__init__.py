@@ -22,7 +22,7 @@ from .operators.window import WindowSpec, Iterable
 from .operators.win_seq import Win_Seq
 from .operators.win_seqffat import Win_SeqFFAT
 from .operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT, Pane_Farm,
-                                     Win_MapReduce)
+                                     Win_MapReduce, Nested_Farm)
 from .runtime import CompiledChain, Pipeline, Stats_Record
 from .runtime.pipegraph import PipeGraph, MultiPipe
 from .runtime.threaded import ThreadedPipeline

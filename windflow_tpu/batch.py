@@ -160,16 +160,36 @@ def hash_key_to_slot(key, num_slots: int):
     slots in ``[0, num_slots)`` — the reference's ``hash(key) % n`` routing contract
     (``wf/standard_emitter.hpp:88-99``) applied at ingest time. Deterministic across
     runs (unlike Python's salted ``hash``)."""
-    if isinstance(key, str):
-        h = 2166136261
-        for ch in key.encode():
-            h = ((h ^ ch) * 16777619) & 0xFFFFFFFF     # FNV-1a
-        return int(h % num_slots)
+    if isinstance(key, (str, bytes)):
+        return _fnv1a(key) % num_slots
     if isinstance(key, (int, np.integer)):
-        return int((int(key) * 2654435761) % (1 << 32) % num_slots)
+        # same arithmetic as the array branch: Knuth multiply in uint64 wraparound
+        k = int(key) & 0xFFFFFFFFFFFFFFFF
+        return int((k * 2654435761) % (1 << 64) % num_slots)
     arr = np.asarray(key)
+    if arr.dtype.kind in "USO":                        # strings / bytes / objects
+        flat = np.asarray([_fnv1a(s) for s in arr.ravel()], np.uint64)
+        return (flat.reshape(arr.shape) % np.uint64(num_slots)).astype(np.int32)
+    if arr.dtype.kind not in "iu":
+        raise TypeError(
+            f"hash_key_to_slot: keys must be ints, strings, or bytes, got dtype "
+            f"{arr.dtype} (float keys would silently truncate and merge)")
     return ((arr.astype(np.uint64) * np.uint64(2654435761)) % np.uint64(num_slots)
             ).astype(np.int32)
+
+
+def _fnv1a(s) -> int:
+    if isinstance(s, str):
+        data = s.encode()
+    elif isinstance(s, bytes):
+        data = s
+    else:
+        raise TypeError(f"hash_key_to_slot: unhashable key {s!r} "
+                        f"(expected str/bytes, got {type(s).__name__})")
+    h = 2166136261
+    for ch in data:
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF         # FNV-1a
+    return h
 
 
 def concat_batches(a: Batch, b: Batch) -> Batch:

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..basic import routing_modes_t, DEFAULT_BATCH_SIZE
-from ..batch import Batch, CTRL_DTYPE
+from ..batch import Batch, CTRL_DTYPE, hash_key_to_slot
 from ..context import RuntimeContext
 from ..meta import classify_source
 from .base import Basic_Operator
@@ -87,13 +87,33 @@ class DeviceSource(SourceBase):
 
 class GeneratorSource(SourceBase):
     """Host source: wraps an iterator of payload pytrees (numpy arrays of equal leading
-    size <= batch_size) or ``(payload, key, ts)`` triples. The general-ingest path."""
+    size <= batch_size) or ``(payload, key, ts)`` triples. The general-ingest path.
+
+    Arbitrary keys (strings, large/sparse ints — the reference's string-keyed tuple
+    contract, ``src/mp_test_cpu`` ``*_str`` variants hashing via ``std::hash``):
+    pass ``num_keys`` to hash every key into ``[0, num_keys)`` slots at ingest
+    (``hash(key) % n``, ``wf/standard_emitter.hpp:88-99``). Without ``num_keys``,
+    keys must already be integer slot indices."""
 
     def __init__(self, it_factory: Callable[[], Iterator], spec: Any, *,
-                 name: str = "source", parallelism: int = 1):
+                 name: str = "source", parallelism: int = 1,
+                 num_keys: Optional[int] = None):
         super().__init__(name, parallelism)
         self.it_factory = it_factory
         self._spec = spec
+        self.num_keys = num_keys
+
+    def _ingest_key(self, key):
+        if key is None:
+            return None
+        if self.num_keys is not None:
+            return hash_key_to_slot(key, self.num_keys)
+        arr = np.asarray(key)
+        if arr.dtype.kind not in "iu":
+            raise TypeError(
+                f"{self.name}: non-integer keys (dtype {arr.dtype}) require "
+                "GeneratorSource(..., num_keys=N) to hash them into key slots")
+        return arr
 
     def payload_spec(self):
         return self._spec
@@ -106,6 +126,7 @@ class GeneratorSource(SourceBase):
                 continue
             if isinstance(item, tuple) and len(item) == 3:
                 payload, key, ts = item
+                key = self._ingest_key(key)
             else:
                 payload, key, ts = item, None, None
             n = np.shape(jax.tree.leaves(payload)[0])[0]

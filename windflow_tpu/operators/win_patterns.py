@@ -46,14 +46,39 @@ from .win_seq import Win_Seq
 from .win_seqffat import Win_SeqFFAT
 
 
+def _check_nesting_args(outer: str, args, kw) -> None:
+    """The nesting ctors take only parallelism/name — the window geometry and key
+    capacity belong to the inner pattern (as in the reference, where the outer farm
+    replicates the inner pattern verbatim, ``wf/win_farm.hpp:266-355``). Reject
+    anything else rather than silently ignoring it."""
+    extra = [repr(a) for a in args] + [k for k in kw if k not in ("parallelism", "name")]
+    if extra:
+        raise TypeError(
+            f"{outer}(inner_pattern, ...): nesting accepts only parallelism= and "
+            f"name= — the window spec / num_keys come from the inner pattern; got "
+            f"extra argument(s): {', '.join(extra)}")
+
+
 class Win_Farm(Win_Seq):
     """Keyless (or keyed) window parallelism. ``parallelism`` declares the number of
     window-axis shards for multi-chip; single-chip, the [W] axis is already the farm.
     The reference's emitter math (window w owned by replica (hash(key)%p + w) % p,
-    ``wf/wf_nodes.hpp:182-204``) becomes the sharding rule of the W axis."""
+    ``wf/wf_nodes.hpp:182-204``) becomes the sharding rule of the W axis.
+
+    Nesting (``wf/win_farm.hpp:266-355``): pass a :class:`Pane_Farm` or
+    :class:`Win_MapReduce` instance as the first argument to replicate that whole
+    pattern as the worker — ``Win_Farm(Pane_Farm(...))``."""
 
     pattern = pattern_t.WF_CPU
     shard_axis = "window"
+
+    def __new__(cls, win_fn=None, *args, **kw):
+        if isinstance(win_fn, (Pane_Farm, Win_MapReduce)):
+            _check_nesting_args(cls.__name__, args, kw)
+            return Nested_Farm(win_fn, shard_axis="window", pattern=pattern_t.WF_CPU,
+                               parallelism=kw.get("parallelism", 1),
+                               name=kw.get("name", f"win_farm[{win_fn.name}]"))
+        return super().__new__(cls)
 
     def __init__(self, win_fn, spec: WindowSpec, *, parallelism: int = 1,
                  num_keys: int = 1, name: str = "win_farm", **kw):
@@ -65,10 +90,21 @@ class Win_Farm(Win_Seq):
 class Key_Farm(Win_Seq):
     """Keyed window parallelism: keys partitioned over replicas, each key's windows
     computed sequentially in order (``wf/key_farm.hpp``). The [K] state axis is the
-    farm; multi-chip shards it."""
+    farm; multi-chip shards it.
+
+    Nesting (``wf/key_farm.hpp:155-167`` worker variants): pass a
+    :class:`Pane_Farm` or :class:`Win_MapReduce` instance as the first argument."""
 
     pattern = pattern_t.KF_CPU
     shard_axis = "key"
+
+    def __new__(cls, win_fn=None, *args, **kw):
+        if isinstance(win_fn, (Pane_Farm, Win_MapReduce)):
+            _check_nesting_args(cls.__name__, args, kw)
+            return Nested_Farm(win_fn, shard_axis="key", pattern=pattern_t.KF_CPU,
+                               parallelism=kw.get("parallelism", 1),
+                               name=kw.get("name", f"key_farm[{win_fn.name}]"))
+        return super().__new__(cls)
 
     def __init__(self, win_fn, spec: WindowSpec, *, parallelism: int = 1,
                  num_keys: int = DEFAULT_MAX_KEYS, name: str = "key_farm", **kw):
@@ -87,6 +123,46 @@ class Key_FFAT(Win_SeqFFAT):
                  num_keys: int = DEFAULT_MAX_KEYS, name: str = "key_ffat", **kw):
         super().__init__(lift, combine, spec=spec, num_keys=num_keys, name=name,
                          parallelism=parallelism, **kw)
+
+
+class Nested_Farm(Basic_Operator):
+    """Composition of an outer distribution pattern (Win_Farm / Key_Farm) with an
+    inner computation pattern (Pane_Farm / Win_MapReduce) — the reference's nesting
+    ctors replicate the whole inner pattern as the farm worker
+    (``wf/win_farm.hpp:266-355``, ``wf/key_farm.hpp:155-167``; flattened by
+    ``optimize_*`` LEVEL2 into one network, ``wf/win_farm.hpp:188-230``).
+
+    Here flattening is inherent: the inner pattern's batched window axis IS the
+    worker pool, and the outer pattern contributes only the multi-chip shard axis
+    ("window" for WF, "key" for KF) plus parallelism metadata."""
+
+    def __init__(self, inner, *, shard_axis: str, pattern, parallelism: int = 1,
+                 name: str | None = None):
+        super().__init__(name or f"nested[{inner.name}]", parallelism)
+        self.inner = inner
+        self.shard_axis = shard_axis
+        self.pattern = pattern
+        self.routing = inner.routing
+        self.spec = inner.spec
+        self.num_keys = getattr(inner, "num_keys", None)
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        self.inner.bind_geometry(batch_capacity)
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return self.inner.out_capacity(in_capacity)
+
+    def init_state(self, payload_spec: Any):
+        return self.inner.init_state(payload_spec)
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        return self.inner.out_spec(payload_spec)
+
+    def apply(self, state, batch: Batch):
+        return self.inner.apply(state, batch)
+
+    def flush(self, state):
+        return self.inner.flush(state)
 
 
 class Pane_Farm(Basic_Operator):
@@ -109,6 +185,8 @@ class Pane_Farm(Basic_Operator):
             raise ValueError("Pane_Farm requires sliding windows (slide < win_len), "
                              "wf/pane_farm.hpp:170-173")
         self.spec = spec
+        self.num_keys = num_keys
+        self.shard_axis = "key"
         self.pane_len = math.gcd(spec.win_len, spec.slide)
         self.wpanes = spec.win_len // self.pane_len
         self.spanes = spec.slide // self.pane_len
@@ -167,8 +245,10 @@ class Win_MapReduce(Basic_Operator):
 
     ``map_fn(wid, iterable) -> partial`` per partition;
     ``reduce_fn(wid, iterable_of_partials) -> result`` over the M partials.
-    CB windows only for the round-robin partition arithmetic (the reference's TB
-    nesting case broadcasts + drops, ``wf/pipegraph.hpp:1922-1930``)."""
+    Supports CB and TB windows: partitioning is round-robin by window-row position
+    (the reference scatters by arrival order, ``wf/wm_nodes.hpp:45-181``; its TB
+    nesting case broadcasts + drops to the same effect, ``wf/pipegraph.hpp:1922-1930``
+    — here the mask-aware row makes both cases the same reshape)."""
 
     routing = routing_modes_t.KEYBY
     pattern = pattern_t.WMR_CPU
@@ -177,27 +257,30 @@ class Win_MapReduce(Basic_Operator):
                  map_parallelism: int = 2, num_keys: int = DEFAULT_MAX_KEYS,
                  name: str = "win_mapreduce", **kw):
         super().__init__(name, map_parallelism)
-        if not spec.is_cb:
-            raise NotImplementedError("Win_MapReduce currently supports CB windows "
-                                      "(reference MAP partitioning is round-robin by "
-                                      "position, wf/wm_nodes.hpp:45-181)")
-        if spec.win_len % map_parallelism:
-            raise ValueError("win_len must be divisible by map_parallelism")
+        if map_parallelism < 2:
+            raise ValueError("Win_MapReduce requires map_parallelism >= 2 "
+                             "(wf/win_mapreduce.hpp:160-166)")
         self.spec = spec
         self.M = int(map_parallelism)
         self.map_fn = map_fn
         self.reduce_fn = reduce_fn
+        self.num_keys = num_keys
+        self.shard_axis = "key"
         # the underlying archive/firing machinery is a Win_Seq whose window function
         # does partition-map + reduce inside the per-window vmap
         self.engine = Win_Seq(self._window_fn, spec, num_keys=num_keys,
                               name=f"{name}_engine", role=role_t.MAP, **kw)
 
     def _window_fn(self, wid, it: Iterable):
-        L, M = self.spec.win_len, self.M
-        P = L // M
-        # round-robin partition p gets positions p, p+M, p+2M, ... (WinMap_Emitter
-        # scatter, wf/wm_nodes.hpp:45-181): reshape [L] -> [P, M] -> transpose [M, P]
-        part = lambda a: jnp.swapaxes(a.reshape((P, M) + a.shape[1:]), 0, 1)
+        M = self.M
+        L = it.mask.shape[0]                  # static row length (win_len for CB,
+        P = -(-L // M)                        # archive ring for TB); pad to P*M
+        def part(a):
+            pad = [(0, P * M - L)] + [(0, 0)] * (a.ndim - 1)
+            a = jnp.pad(a, pad) if P * M != L else a
+            # round-robin: partition p gets positions p, p+M, p+2M, ...
+            # (WinMap_Emitter scatter): reshape [PM] -> [P, M] -> transpose [M, P]
+            return jnp.swapaxes(a.reshape((P, M) + a.shape[1:]), 0, 1)
         sub = Iterable(data=jax.tree.map(part, it.data), ids=part(it.ids),
                        ts=part(it.ts), mask=part(it.mask))
         partials = jax.vmap(lambda s: self.map_fn(wid, s))(sub)

@@ -223,18 +223,41 @@ class WinSeqFFAT_Builder(_WinBuilder):
         return Win_SeqFFAT(lift, comb, spec=self._spec(), **self._kw)
 
 
+def _nesting_kw(builder: str, win, kw) -> dict:
+    """Nested builds take only withParallelism/withName — window geometry belongs
+    to the inner pattern's builder (extra kwargs are rejected downstream by the
+    ctor's nesting check, win_patterns._check_nesting_args)."""
+    if win is not None:
+        raise TypeError(
+            f"{builder}(inner_pattern): nesting accepts only withParallelism/"
+            f"withName — configure windows on the inner builder, not "
+            f"withCB/TBWindows here")
+    return kw
+
+
 class WinFarm_Builder(_WinBuilder):
-    """wf/builders.hpp:1120."""
+    """wf/builders.hpp:1120. Accepts a window function, or a built Pane_Farm /
+    Win_MapReduce for the nesting ctors (``wf/win_farm.hpp:266-355``) — in that case
+    the window spec comes from the inner pattern."""
     def build(self):
         self._pop_private()
-        return Win_Farm(self._fns[0], self._spec(), **self._kw)
+        inner = self._fns[0]
+        if isinstance(inner, (Pane_Farm, Win_MapReduce)):
+            return Win_Farm(inner, **_nesting_kw("WinFarm_Builder", self._win,
+                                                 self._kw))
+        return Win_Farm(inner, self._spec(), **self._kw)
 
 
 class KeyFarm_Builder(_WinBuilder):
-    """wf/builders.hpp:1343."""
+    """wf/builders.hpp:1343. Accepts a window function, or a built Pane_Farm /
+    Win_MapReduce for the nesting ctors (``wf/key_farm.hpp:155-167``)."""
     def build(self):
         self._pop_private()
-        return Key_Farm(self._fns[0], self._spec(), **self._kw)
+        inner = self._fns[0]
+        if isinstance(inner, (Pane_Farm, Win_MapReduce)):
+            return Key_Farm(inner, **_nesting_kw("KeyFarm_Builder", self._win,
+                                                 self._kw))
+        return Key_Farm(inner, self._spec(), **self._kw)
 
 
 class KeyFFAT_Builder(_WinBuilder):
