@@ -53,6 +53,11 @@ def test_hash_key_scalar_array_agree():
     import pytest
     with pytest.raises(TypeError, match="float"):
         hash_key_to_slot(np.asarray([1.2, 1.9]), 4)
+    # object arrays of (big) ints agree with the scalar int path
+    big = 2 ** 70 + 3
+    oarr = hash_key_to_slot(np.asarray([big, 5], dtype=object), 8)
+    assert int(oarr[0]) == hash_key_to_slot(big, 8)
+    assert int(oarr[1]) == hash_key_to_slot(5, 8)
 
 
 def test_generator_source_string_keys():

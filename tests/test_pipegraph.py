@@ -121,3 +121,89 @@ def test_dot_dump_and_introspection():
     assert "digraph PipeGraph" in dot and "gen" in dot
     assert len(g.listOperators()) == 3
     assert g.getNumThreads() == 3
+
+
+# ---- graph_test DAG-shape suite (src/graph_test/test_graph_{1..9}.cpp shapes)
+
+def test_merge_then_split():
+    """graph_1 shape: two source pipes -> merge -> filter -> split -> two sinks."""
+    g = PipeGraph("g1", batch_size=64)
+    s1 = wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=120, name="s1")
+    s2 = wf.Source(lambda i: {"v": (i + 1000).astype(jnp.int32)}, total=120, name="s2")
+    merged = g.add_source(s1).merge(g.add_source(s2))
+    merged.add(wf.Filter(lambda t: t.v % 2 == 0))
+    merged.split(lambda t: (t.v >= 1000).astype(jnp.int32), 2)
+    merged.select(0).add(wf.ReduceSink(lambda t: t.v, name="low"))
+    merged.select(1).add(wf.ReduceSink(lambda t: t.v, name="high"))
+    res = g.run()
+    assert int(res["low"]) == sum(i for i in range(120) if i % 2 == 0)
+    assert int(res["high"]) == sum(i for i in range(1000, 1120) if i % 2 == 0)
+
+
+def test_nested_split():
+    """graph_4 shape: a split branch splits again (3 leaf sinks)."""
+    total = 300
+    g = PipeGraph("g4", batch_size=64)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total))
+    mp.split(lambda t: (t.v % 3 == 0).astype(jnp.int32), 2)
+    b_rest = mp.select(0)          # v % 3 != 0
+    b_mul3 = mp.select(1)          # v % 3 == 0
+    b_rest.split(lambda t: (t.v % 3 - 1).astype(jnp.int32), 2)
+    b_rest.select(0).add(wf.ReduceSink(lambda t: t.v, name="r1"))
+    b_rest.select(1).add(wf.ReduceSink(lambda t: t.v, name="r2"))
+    b_mul3.add(wf.ReduceSink(lambda t: t.v, name="r0"))
+    res = g.run()
+    assert int(res["r0"]) == sum(i for i in range(total) if i % 3 == 0)
+    assert int(res["r1"]) == sum(i for i in range(total) if i % 3 == 1)
+    assert int(res["r2"]) == sum(i for i in range(total) if i % 3 == 2)
+
+
+def test_merge_split_branch_with_independent_pipe():
+    """graph_3 shape: one branch of a split merges with an independent source pipe."""
+    g = PipeGraph("g3", batch_size=64)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=200,
+                                name="sa"))
+    mp.split(lambda t: (t.v % 2).astype(jnp.int32), 2)
+    b0 = mp.select(0)
+    b1 = mp.select(1)
+    ind = g.add_source(wf.Source(lambda i: {"v": (i + 5000).astype(jnp.int32)},
+                                 total=50, name="sb"))
+    merged = b1.merge(ind)
+    merged.add(wf.ReduceSink(lambda t: t.v, name="m"))
+    b0.add(wf.ReduceSink(lambda t: t.v, name="b0"))
+    res = g.run()
+    assert int(res["b0"]) == sum(i for i in range(200) if i % 2 == 0)
+    assert int(res["m"]) == sum(i for i in range(200) if i % 2 == 1) + \
+        sum(range(5000, 5050))
+
+
+def test_two_disjoint_graphs():
+    """graph_5 shape: two unconnected pipelines inside one PipeGraph."""
+    g = PipeGraph("g5", batch_size=32)
+    g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=80,
+                           name="sA")).add(
+        wf.Map(lambda t: {"v": t.v * 2})).add(
+        wf.ReduceSink(lambda t: t.v, name="a"))
+    g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=60,
+                           name="sB")).add(
+        wf.Filter(lambda t: t.v < 30)).add(
+        wf.ReduceSink(lambda t: t.v, name="b"))
+    res = g.run()
+    assert int(res["a"]) == sum(2 * i for i in range(80))
+    assert int(res["b"]) == sum(range(30))
+
+
+def test_merge_three_pipes():
+    """3-way merge (graph_6/7 family): two split branches + independent pipe in one
+    merge call."""
+    g = PipeGraph("g6", batch_size=64)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=90,
+                                name="sa"))
+    mp.split(lambda t: (t.v % 2).astype(jnp.int32), 2)
+    b0, b1 = mp.select(0), mp.select(1)
+    ind = g.add_source(wf.Source(lambda i: {"v": (i + 700).astype(jnp.int32)},
+                                 total=10, name="sb"))
+    merged = b0.merge(b1, ind)
+    merged.add(wf.ReduceSink(lambda t: t.v, name="all"))
+    res = g.run()
+    assert int(res["all"]) == sum(range(90)) + sum(range(700, 710))

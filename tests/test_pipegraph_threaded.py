@@ -56,3 +56,27 @@ def test_threaded_windowed_pipeline():
         for w in range((len(vals) - 1) // 10 + 1):
             expect.append((k, w, sum(vals[w * 10:(w + 1) * 10])))
     assert sorted(got) == sorted(expect)
+
+
+def test_threaded_nested_split_and_3way_merge():
+    """Threaded driver on the deeper graph_test shapes: nested split + 3-way merge."""
+    def build(threaded):
+        g = PipeGraph("tg", batch_size=64)
+        mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=240,
+                                    name="sa"))
+        mp.split(lambda t: (t.v % 3 == 0).astype(jnp.int32), 2)
+        b_rest, b_mul3 = mp.select(0), mp.select(1)
+        b_rest.split(lambda t: (t.v % 3 - 1).astype(jnp.int32), 2)
+        r1 = b_rest.select(0)
+        r2 = b_rest.select(1)
+        ind = g.add_source(wf.Source(lambda i: {"v": (i + 900).astype(jnp.int32)},
+                                     total=12, name="sb"))
+        merged = r1.merge(r2, ind)
+        merged.add(wf.ReduceSink(lambda t: t.v, name="m"))
+        b_mul3.add(wf.ReduceSink(lambda t: t.v, name="z"))
+        return {k: int(v) for k, v in g.run(threaded=threaded).items()}
+
+    seq, thr = build(False), build(True)
+    assert seq == thr
+    assert seq["z"] == sum(i for i in range(240) if i % 3 == 0)
+    assert seq["m"] == sum(i for i in range(240) if i % 3) + sum(range(900, 912))

@@ -101,6 +101,28 @@ def test_win_mapreduce_tb():
     assert collect(160, 2, wmr) == winseq_oracle(160, 2, spec)
 
 
+def test_win_mapreduce_empty_partition_not_poisoning_reduce():
+    # TB window with fewer tuples than map_parallelism: the empty partition's
+    # identity partial (sum -> 0) must not enter a min-reduce
+    spec = WindowSpec(2, 2, win_type_t.TB)
+    wmr = Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.min(),
+                        spec, map_parallelism=3, num_keys=1)
+    src = wf.Source(lambda i: {"v": (i + 1).astype(jnp.float32)}, total=8,
+                    num_keys=1)
+    got = []
+
+    def cb(view):
+        if view is None:
+            return
+        got.extend((int(w), float(r)) for w, r in
+                   zip(view["id"].tolist(), np.asarray(view["payload"]).tolist()))
+
+    wf.Pipeline(src, [wmr], wf.Sink(cb), batch_size=8).run()
+    # windows {1,2},{3,4},{5,6},{7,8}: each has 2 tuples over 3 partitions; the
+    # min over non-empty partials is the smaller value, never the empty 0.0
+    assert sorted(got) == [(0, 1.0), (1, 3.0), (2, 5.0), (3, 7.0)]
+
+
 def test_win_mapreduce_sliding():
     spec = WindowSpec(8, 4, win_type_t.CB)
     wmr = Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),

@@ -168,8 +168,11 @@ def hash_key_to_slot(key, num_slots: int):
         return int((k * 2654435761) % (1 << 64) % num_slots)
     arr = np.asarray(key)
     if arr.dtype.kind in "USO":                        # strings / bytes / objects
-        flat = np.asarray([_fnv1a(s) for s in arr.ravel()], np.uint64)
-        return (flat.reshape(arr.shape) % np.uint64(num_slots)).astype(np.int32)
+        # hash each distinct key once (batches typically repeat few keys)
+        uniq, inv = np.unique(arr.ravel(), return_inverse=True)
+        slots = np.asarray([hash_key_to_slot(u, num_slots) for u in uniq.tolist()],
+                           np.int32)
+        return slots[inv].reshape(arr.shape)
     if arr.dtype.kind not in "iu":
         raise TypeError(
             f"hash_key_to_slot: keys must be ints, strings, or bytes, got dtype "

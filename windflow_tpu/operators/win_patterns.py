@@ -285,12 +285,14 @@ class Win_MapReduce(Basic_Operator):
                        ts=part(it.ts), mask=part(it.mask))
         partials = jax.vmap(lambda s: self.map_fn(wid, s))(sub)
         # REDUCE over the M partials (CB window of length M in the reference,
-        # wf/win_mapreduce.hpp:180-230)
+        # wf/win_mapreduce.hpp:180-230). A partition that received no tuples
+        # contributes no partial — mask it out so identity values (e.g. 0 from an
+        # empty sum) can't poison non-sum reduces like min.
         red_it = Iterable(
             data=partials,
             ids=jnp.arange(M, dtype=CTRL_DTYPE),
             ts=jnp.broadcast_to(jnp.asarray(0, CTRL_DTYPE), (M,)),
-            mask=jnp.ones((M,), jnp.bool_))
+            mask=jnp.any(part(it.mask), axis=1))
         return self.reduce_fn(wid, red_it)
 
     def bind_geometry(self, batch_capacity: int) -> None:
