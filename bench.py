@@ -18,7 +18,7 @@ import os
 import sys
 import time
 
-BATCH = int(os.environ.get("WF_BENCH_BATCH", 1 << 16))
+BATCH = int(os.environ.get("WF_BENCH_BATCH", 1 << 20))
 STEPS = int(os.environ.get("WF_BENCH_STEPS", 40))
 BASELINE_TPS = 16.6e6
 
@@ -48,7 +48,7 @@ def bench_ysb():
     panes_per_batch = BATCH // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN) + 1
     src = ysb.make_source(total=(STEPS + 2) * BATCH)
     ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
-                       max_wins=2 * panes_per_batch + 64)
+                       max_wins=panes_per_batch + 64)
     chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
 
     def step(states, start):

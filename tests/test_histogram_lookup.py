@@ -1,0 +1,123 @@
+"""MXU histogram (ops/histogram.py) and factored table lookup (ops/lookup.py):
+exactness against the scatter/gather reference on random data, including the
+locality-violation fallback and ring wrap-around."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from windflow_tpu.ops.histogram import keyed_pane_histogram, _scatter_hist
+from windflow_tpu.ops.lookup import table_lookup, _factored_lookup
+
+
+def ref_hist(key, pane, valid, K, P):
+    out = np.zeros((K, P), np.int32)
+    for k, p, v in zip(key, pane, valid):
+        if v:
+            out[k, p % P] += 1
+    return out
+
+
+@pytest.mark.parametrize("C,K,P", [(4096, 7, 64), (8192, 100, 256)])
+def test_hist_sorted_ts(C, K, P):
+    rng = np.random.default_rng(0)
+    key = rng.integers(0, K, C).astype(np.int32)
+    # locally-clustered panes: nondecreasing ts
+    pane = (np.arange(C) // 97).astype(np.int32) + 5
+    valid = rng.random(C) < 0.7
+    got = jax.jit(lambda *a: keyed_pane_histogram(*a, K, P))(
+        jnp.asarray(key), jnp.asarray(pane), jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(got), ref_hist(key, pane, valid, K, P))
+
+
+def test_hist_wraparound():
+    C, K, P = 4096, 5, 32
+    rng = np.random.default_rng(1)
+    key = rng.integers(0, K, C).astype(np.int32)
+    pane = (np.arange(C) // 130 + P - 3).astype(np.int32)   # crosses the ring edge
+    valid = np.ones(C, bool)
+    got = jax.jit(lambda *a: keyed_pane_histogram(*a, K, P))(
+        jnp.asarray(key), jnp.asarray(pane), jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(got), ref_hist(key, pane, valid, K, P))
+
+
+def test_hist_fallback_unordered():
+    """Panes scattered randomly violate chunk locality -> scatter fallback, same
+    result."""
+    C, K, P = 4096, 11, 64
+    rng = np.random.default_rng(2)
+    key = rng.integers(0, K, C).astype(np.int32)
+    pane = rng.integers(0, 1000, C).astype(np.int32)
+    valid = rng.random(C) < 0.5
+    got = jax.jit(lambda *a: keyed_pane_histogram(*a, K, P))(
+        jnp.asarray(key), jnp.asarray(pane), jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(got), ref_hist(key, pane, valid, K, P))
+
+
+def test_hist_odd_capacity_and_empty():
+    C, K, P = 1000, 3, 16          # C not a multiple of the chunk -> scatter path
+    key = np.zeros(C, np.int32)
+    pane = np.zeros(C, np.int32)
+    valid = np.zeros(C, bool)
+    got = keyed_pane_histogram(jnp.asarray(key), jnp.asarray(pane),
+                               jnp.asarray(valid), K, P)
+    assert int(jnp.sum(got)) == 0
+
+
+@pytest.mark.parametrize("K", [100, 1000, 4000])
+def test_factored_lookup_int(K):
+    rng = np.random.default_rng(3)
+    tbl = rng.integers(0, 1 << 20, K).astype(np.int32)
+    idx = rng.integers(0, K, 2048).astype(np.int32)
+    got = table_lookup(jnp.asarray(tbl), jnp.asarray(idx))
+    np.testing.assert_array_equal(np.asarray(got), tbl[idx])
+
+
+def test_factored_lookup_float():
+    rng = np.random.default_rng(4)
+    tbl = rng.standard_normal(777).astype(np.float32)
+    idx = rng.integers(0, 777, 512).astype(np.int32)
+    got = _factored_lookup(jnp.asarray(tbl), jnp.asarray(idx))
+    np.testing.assert_array_equal(np.asarray(got), tbl[idx])  # bit-exact selection
+
+
+def test_lookup_large_int_values_fall_back():
+    """Values >= 2^24 are not f32-exact: must take the gather path and stay exact."""
+    tbl = np.array([0, (1 << 24) + 1, 5, 7] * 300, np.int32)
+    idx = np.array([1, 2, 1199], np.int32)
+    got = table_lookup(jnp.asarray(tbl), jnp.asarray(idx))
+    np.testing.assert_array_equal(np.asarray(got), tbl[idx])
+
+
+def test_count_lift_autodetect():
+    from windflow_tpu.operators.win_seqffat import _detect_count_lift
+    from windflow_tpu.batch import Batch
+
+    b = Batch(key=jnp.zeros(8, jnp.int32), id=jnp.zeros(8, jnp.int32),
+              ts=jnp.zeros(8, jnp.int32),
+              payload={"v": jnp.zeros(8, jnp.int32)}, valid=jnp.ones(8, bool))
+    assert _detect_count_lift(lambda t: jnp.ones((), jnp.int32), b)
+    assert not _detect_count_lift(lambda t: t.data["v"], b)
+    assert not _detect_count_lift(lambda t: jnp.zeros((), jnp.int32), b)
+    assert not _detect_count_lift(lambda t: {"a": jnp.ones(()), "b": jnp.ones(())}, b)
+
+
+def test_lookup_inf_float_table_falls_back():
+    """inf sentinels (running-max identities) must not NaN-poison other rows."""
+    tbl = np.full(1024, -np.inf, np.float32)
+    tbl[3] = 3.0
+    idx = np.array([3, 5], np.int32)
+    got = table_lookup(jnp.asarray(tbl), jnp.asarray(idx))
+    assert float(got[0]) == 3.0 and np.isneginf(float(got[1]))
+
+
+def test_hist_many_keys_tiled():
+    C, K, P = 4096, 1500, 64          # K > K_TILE exercises key-axis tiling
+    rng = np.random.default_rng(5)
+    key = rng.integers(0, K, C).astype(np.int32)
+    pane = (np.arange(C) // 511).astype(np.int32)
+    valid = rng.random(C) < 0.9
+    got = jax.jit(lambda *a: keyed_pane_histogram(*a, K, P))(
+        jnp.asarray(key), jnp.asarray(pane), jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(got), ref_hist(key, pane, valid, K, P))

@@ -68,7 +68,8 @@ class Win_SeqFFAT(Basic_Operator):
                  identity: Any = 0, num_keys: int = DEFAULT_MAX_KEYS,
                  pane_len: int = None, pane_capacity: int = None,
                  max_wins: int = None, name: str = "win_seqffat",
-                 parallelism: int = 1, global_time: bool = None):
+                 parallelism: int = 1, global_time: bool = None,
+                 count_lift: bool = None):
         super().__init__(name, parallelism)
         import math
         # global_time (TB only): all keys share the event clock — watermark and the
@@ -84,6 +85,9 @@ class Win_SeqFFAT(Basic_Operator):
         self.lift = lift
         self.combine = combine
         self.identity = identity
+        #: lift(t) == 1 for every t (windowed count): the pane-value update equals
+        #: the occupancy histogram and rides the MXU path. None = auto-detect.
+        self.count_lift = count_lift
         self.spec = spec
         self.num_keys = int(num_keys)
         # pane length: gcd(win, slide) — every window is a whole number of panes and
@@ -157,34 +161,42 @@ class Win_SeqFFAT(Basic_Operator):
     # ---------------------------------------------------- global-time fast path (TB)
 
     def _g_insert(self, state: GFFATState, batch: Batch):
-        """ONE packed scatter-add of the lifted values (+ one for occupancy): slot
+        """Fold a batch into the [K, P] pane ring. The occupancy counts — and, for a
+        count-like lift (lift(t) == 1, the YSB/windowed-count case), the partials
+        themselves — go through the MXU histogram (``ops/histogram.py``) instead of a
+        serialized scatter-add; other lifts keep the segment-reduce path. Slot
         cleanliness is maintained by clear-on-fire in ``_g_emit`` so no pane-id
         bookkeeping is needed; OLD tuples (pane already fired) are dropped with a
-        scalar horizon compare — no gathers anywhere."""
+        scalar horizon compare."""
+        from ..ops.histogram import keyed_pane_histogram
         K, P = self.num_keys, self.P
         pane = batch.ts // self.pane_len
         horizon = state.next_win * self.spanes       # first un-fired pane (global)
         valid = batch.valid & (pane >= horizon)
-        slot = pane % P
-        seg = jnp.where(valid, batch.key * P + slot, K * P)
-        lifted = jax.vmap(self.lift)(
-            TupleRef(key=batch.key, id=batch.id, ts=batch.ts, data=batch.payload))
-        # two 1-D scatter-adds: measured faster than one packed [C, n+1] scatter
-        # (wide updates hit a slower XLA scatter emitter on TPU)
-        ones = valid.astype(CTRL_DTYPE)
-        if self.combine is jnp.add:
-            upd = segment_reduce(lifted, seg, valid, K * P)
+        cnt_upd = keyed_pane_histogram(batch.key, pane, valid, K, P)
+        cnt = state.cnt + cnt_upd
+        if self.count_lift is None:
+            self.count_lift = _detect_count_lift(self.lift, batch)
+        if self.count_lift and self.combine is jnp.add:
+            # lift == 1: the value histogram IS the count histogram
             panes = jax.tree.map(
-                lambda t, u: t + u.reshape((K, P) + u.shape[1:]),
-                state.panes, upd)
+                lambda t: t + cnt_upd.astype(t.dtype), state.panes)
         else:
-            upd = segment_reduce(lifted, seg, valid, K * P,
-                                 combine=self.combine, identity=self.identity)
-            panes = jax.tree.map(
-                lambda t, u: self.combine(t, u.reshape((K, P) + u.shape[1:])),
-                state.panes, upd)
-        cnt_upd = segment_reduce(ones, seg, valid, K * P)
-        cnt = state.cnt + cnt_upd.reshape(K, P)
+            slot = pane % P
+            seg = jnp.where(valid, batch.key * P + slot, K * P)
+            lifted = jax.vmap(self.lift)(TupleRef(
+                key=batch.key, id=batch.id, ts=batch.ts, data=batch.payload))
+            if self.combine is jnp.add:
+                upd = segment_reduce(lifted, seg, valid, K * P)
+                panes = jax.tree.map(
+                    lambda t, u: t + u.reshape((K, P) + u.shape[1:]),
+                    state.panes, upd)
+            else:
+                upd = segment_reduce(lifted, seg, valid, K * P,
+                                     combine=self.combine, identity=self.identity)
+                panes = jax.tree.map(
+                    lambda t, u: self.combine(t, u.reshape((K, P) + u.shape[1:])),
+                    state.panes, upd)
         return dataclasses.replace(
             state,
             panes=panes,
@@ -209,14 +221,28 @@ class Win_SeqFFAT(Basic_Operator):
 
         wid = lo + jnp.arange(W_n, dtype=CTRL_DTYPE)          # [W_n]
         w_valid = jnp.arange(W_n, dtype=CTRL_DTYPE) < n_w
-        pane_ids = wid[:, None] * self.spanes + jnp.arange(
-            self.wpanes, dtype=CTRL_DTYPE)[None, :]           # [W_n, wpanes]
-        slot = pane_ids % P
-        # gather [K, W_n*wpanes] columns from the [K, P] table: constant per-key
-        # column indices — one vectorized take along axis 1
-        def gat(tbl):                                         # tbl [K, P, ...]
-            g = jnp.take(tbl, slot.reshape(-1), axis=1)       # [K, W_n*wpanes, ...]
-            return g.reshape((K, W_n, self.wpanes) + tbl.shape[2:])
+        # The fired windows' panes form a CONTIGUOUS cyclic range starting at
+        # lo*spanes: roll the ring so it starts at column 0, then extraction is a
+        # static strided window — no dynamic gather at all. (Fallback to a dynamic
+        # take when the static window would overrun the ring.)
+        static_span = (W_n - 1) * self.spanes + self.wpanes
+        if static_span <= P:
+            shift = (lo * self.spanes) % P
+            idx = (jnp.arange(W_n, dtype=CTRL_DTYPE)[:, None] * self.spanes
+                   + jnp.arange(self.wpanes, dtype=CTRL_DTYPE)[None, :])
+
+            def gat(tbl):                                     # tbl [K, P, ...]
+                rolled = jnp.roll(tbl, -shift, axis=1)
+                g = jnp.take(rolled, idx.reshape(-1), axis=1)  # static indices
+                return g.reshape((K, W_n, self.wpanes) + tbl.shape[2:])
+        else:
+            pane_ids = wid[:, None] * self.spanes + jnp.arange(
+                self.wpanes, dtype=CTRL_DTYPE)[None, :]       # [W_n, wpanes]
+            slot = pane_ids % P
+
+            def gat(tbl):                                     # tbl [K, P, ...]
+                g = jnp.take(tbl, slot.reshape(-1), axis=1)   # [K, W_n*wpanes, ...]
+                return g.reshape((K, W_n, self.wpanes) + tbl.shape[2:])
         cnts = gat(state.cnt)                                 # [K, W_n, wpanes]
         win_cnt = jnp.sum(cnts, axis=2)                       # [K, W_n]
         def reduce_w(tbl):
@@ -383,6 +409,45 @@ class Win_SeqFFAT(Basic_Operator):
         if not bool(jnp.any(out.valid)):
             return state, None
         return state, out
+
+
+def _detect_count_lift(lift, batch) -> bool:
+    """True iff ``lift`` provably returns the constant scalar 1 for every tuple:
+    its jaxpr output must not depend on the input vars, and its value on a zero
+    tuple must be 1. Conservative — any doubt returns False."""
+    import numpy as np
+    dummy = TupleRef(
+        key=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+        id=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+        ts=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+        data=jax.tree.map(lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype),
+                          batch.payload))
+    try:
+        from jax.extend import core as jex_core
+        literal_t = jex_core.Literal
+    except ImportError:
+        from jax._src.core import Literal as literal_t
+    try:
+        closed = jax.make_jaxpr(lift)(dummy)
+        jaxpr = closed.jaxpr
+        tainted = {id(v) for v in jaxpr.invars}
+        for eqn in jaxpr.eqns:
+            if any(not isinstance(v, literal_t) and id(v) in tainted
+                   for v in eqn.invars):
+                tainted |= {id(v) for v in eqn.outvars}
+        if any(not isinstance(v, literal_t) and id(v) in tainted
+               for v in jaxpr.outvars):
+            return False
+        zero = TupleRef(
+            key=np.zeros((), np.int32), id=np.zeros((), np.int32),
+            ts=np.zeros((), np.int32),
+            data=jax.tree.map(lambda l: np.zeros(l.shape[1:], l.dtype),
+                              batch.payload))
+        out = jax.tree.leaves(lift(zero))
+        return (len(out) == 1 and np.shape(out[0]) == ()
+                and float(out[0]) == 1.0)
+    except Exception:
+        return False
 
 
 def _b(mask, v):

@@ -13,17 +13,54 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-#: table sizes up to this use the select-based path (break-even ~2800 rows measured)
-SELECT_MAX_ROWS = 2048
+#: 1-D table sizes up to this use the one-shot select path
+SELECT_MAX_ROWS = 128
+#: 2-D tables keep the select-reduce up to this many rows (break-even ~2800 measured)
+SELECT_MAX_ROWS_2D = 2048
+#: factored path handles tables up to this many rows (cost ~ C * 2 * sqrt(K))
+FACTORED_MAX_ROWS = 1 << 16
+
+
+def _exact_in_f32(table: jax.Array) -> bool:
+    """True when every table value is exactly representable in float32 (so a one-hot
+    f32 matmul — a sum with a single nonzero term — reproduces it bit-exactly)."""
+    if table.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return True
+    if jnp.issubdtype(table.dtype, jnp.integer):
+        bits = jnp.iinfo(table.dtype).bits
+        return bits <= 16          # |v| <= 2^16 < 2^24: exact in f32
+    return table.dtype == jnp.bool_
 
 
 def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     """``table[idx]`` with the fastest strategy for the table size.
 
+    Strategies (1-D tables): tiny tables use a select-reduce on the VPU; larger ones
+    factor the index as ``hi * K2 + lo`` and select the row with a one-hot matmul
+    then the column with a select-reduce — O(C * (K1 + K2)) work instead of the
+    O(C * K) select or the ~5.6 ns/element serialized gather ``jnp.take`` lowers to.
+    int32 tables with values that may exceed 2^24 fall back to ``take`` (f32 selection
+    would round them).
+
     ``table``: ``[K, ...]``; ``idx``: ``[C]`` int32 in [0, K). Out-of-range indices
-    return row 0 contributions only in the select path; clamp beforehand if needed."""
+    return 0 in the select/factored paths; clamp beforehand if needed."""
     K = table.shape[0]
-    if K > SELECT_MAX_ROWS or table.ndim > 2:
+    if table.ndim == 1 and SELECT_MAX_ROWS < K <= FACTORED_MAX_ROWS:
+        import numpy as np
+        concrete = table.size and not isinstance(table, jax.core.Tracer)
+        if jnp.issubdtype(table.dtype, jnp.floating):
+            # 0 * inf = NaN in the one-hot matmul would poison other rows:
+            # only concretely all-finite float tables take the factored path
+            if concrete and bool(np.isfinite(np.asarray(table)).all()):
+                return _factored_lookup(table, idx)
+        elif _exact_in_f32(table):
+            return _factored_lookup(table, idx)
+        elif (jnp.issubdtype(table.dtype, jnp.integer) and concrete
+                and np.abs(np.asarray(table)).max() < (1 << 24)):
+            return _factored_lookup(table, idx)
+        return jnp.take(table, idx, axis=0)
+    limit = SELECT_MAX_ROWS if table.ndim == 1 else SELECT_MAX_ROWS_2D
+    if K > limit or table.ndim > 2:
         return jnp.take(table, idx, axis=0)
     oh = idx[:, None] == jnp.arange(K, dtype=idx.dtype)[None, :]      # [C, K]
     if table.ndim == 1:
@@ -32,3 +69,21 @@ def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     # [C, K, V] select-reduce for small trailing dims
     return jnp.sum(jnp.where(oh[:, :, None], table[None, :, :],
                              jnp.zeros((), table.dtype)), axis=1)
+
+
+def _factored_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """Row-select by one-hot matmul over K1, column-select on the VPU over K2."""
+    import math
+    K = table.shape[0]
+    K2 = 1 << max(1, (K - 1).bit_length() // 2)        # ~sqrt(K), power of two
+    K1 = (K + K2 - 1) // K2
+    pad = K1 * K2 - K
+    t2 = jnp.pad(table, (0, pad)).reshape(K1, K2).astype(jnp.float32)
+    hi = idx // K2
+    lo = idx - hi * K2
+    ohhi = (hi[:, None] == jnp.arange(K1, dtype=idx.dtype)).astype(jnp.float32)
+    rows = jax.lax.dot_general(ohhi, t2, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)   # [C, K2]
+    ohlo = lo[:, None] == jnp.arange(K2, dtype=idx.dtype)
+    out = jnp.sum(jnp.where(ohlo, rows, 0.0), axis=1)
+    return out.astype(table.dtype)
