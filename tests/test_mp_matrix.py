@@ -62,6 +62,26 @@ CASES = {
                                     lambda wid, it: it.sum(),
                                     WindowSpec(8, 8, win_type_t.CB),
                                     map_parallelism=2, num_keys=K),
+    "win_farm_tb": lambda: Win_Farm(lambda wid, it: it.sum("v"),
+                                    WindowSpec(12, 4, win_type_t.TB),
+                                    parallelism=4, num_keys=K),
+    "key_farm_tb": lambda: Key_Farm(lambda wid, it: it.max("v"),
+                                    WindowSpec(10, 5, win_type_t.TB),
+                                    parallelism=3, num_keys=K),
+    "pane_farm_tb": lambda: Pane_Farm(lambda pid, it: it.sum("v"),
+                                      lambda wid, it: it.sum(),
+                                      WindowSpec(12, 4, win_type_t.TB), num_keys=K),
+    "wmr_tb": lambda: Win_MapReduce(lambda wid, it: it.sum("v"),
+                                    lambda wid, it: it.sum(),
+                                    WindowSpec(12, 12, win_type_t.TB),
+                                    map_parallelism=3, num_keys=K),
+    "nested_wf_pf_cb": lambda: Win_Farm(
+        Pane_Farm(lambda pid, it: it.sum("v"), lambda wid, it: it.sum(),
+                  WindowSpec(9, 3, win_type_t.CB), num_keys=K), parallelism=2),
+    "nested_kf_wmr_cb": lambda: Key_Farm(
+        Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                      WindowSpec(8, 8, win_type_t.CB), map_parallelism=2,
+                      num_keys=K), parallelism=2),
 }
 
 

@@ -58,10 +58,15 @@ def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
         elif (jnp.issubdtype(table.dtype, jnp.integer) and concrete
                 and np.abs(np.asarray(table)).max() < (1 << 24)):
             return _factored_lookup(table, idx)
-        return jnp.take(table, idx, axis=0)
-    limit = SELECT_MAX_ROWS if table.ndim == 1 else SELECT_MAX_ROWS_2D
-    if K > limit or table.ndim > 2:
-        return jnp.take(table, idx, axis=0)
+        # factored path unavailable (traced table / values beyond f32-exact range):
+        # the select-reduce below is exact in the table's own dtype and still beats
+        # the serialized gather up to the 2-D break-even
+        if K > SELECT_MAX_ROWS_2D:
+            return jnp.take(table, idx, axis=0)
+    else:
+        limit = SELECT_MAX_ROWS if table.ndim == 1 else SELECT_MAX_ROWS_2D
+        if K > limit or table.ndim > 2:
+            return jnp.take(table, idx, axis=0)
     oh = idx[:, None] == jnp.arange(K, dtype=idx.dtype)[None, :]      # [C, K]
     if table.ndim == 1:
         return jnp.sum(jnp.where(oh, table[None, :], jnp.zeros((), table.dtype)),

@@ -1,0 +1,204 @@
+"""Explicit-collective multi-chip patterns over ICI: shard_map formulations of the
+reference's cross-replica exchanges.
+
+The GSPMD path (``parallel/sharding.py``) lets XLA infer collectives from sharding
+annotations; this module is the hand-written counterpart for the three exchanges whose
+communication pattern IS the algorithm — the cases where the reference dedicates a
+custom emitter/topology:
+
+- :func:`wmr_map_reduce` — Win_MapReduce with the MAP partition axis sharded over
+  devices and the REDUCE combine as an ICI all-reduce (``psum``-style tree combine).
+  Reference: WinMap_Emitter round-robin partitioning + REDUCE stage
+  (``wf/win_mapreduce.hpp:180-230``, ``wf/wm_nodes.hpp:45-181``). Use when one
+  window's content is too large for one chip.
+- :func:`ring_pane_windows` — sliding windows over a pane-partial axis sharded in
+  contiguous blocks, with boundary panes rotated from ring neighbours via
+  ``ppermute`` (the ring-attention communication shape applied to Pane_Farm: each
+  device combines local pane partials, pulls the (win_panes-1) successor panes it is
+  missing from the next device(s) around the ring, never materializing the full pane
+  axis anywhere). Reference: PLQ/WLQ pane sharing (``wf/pane_farm.hpp:175-213``) —
+  single-process there, cross-chip here.
+- :func:`keyed_all_to_all` — redistribute a batch so every tuple lands on the device
+  that owns its key: per-destination compaction + ``lax.all_to_all``. This is the
+  KF_Emitter / Standard_EmitterGPU ``create_sub_batch`` exchange
+  (``wf/kf_nodes.hpp:74-90``, ``wf/standard_nodes_gpu.hpp:52-238``) carried over
+  chip boundaries instead of thread queues.
+
+All functions take an explicit mesh-axis name and run inside
+``jax.shard_map``; static shapes throughout (fixed per-destination capacity +
+validity masks — the batch discipline of the whole framework).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.segment import segment_rank
+
+try:                                     # jax >= 0.4.35
+    from jax import shard_map as _shard_map
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+# -- Win_MapReduce over ICI ------------------------------------------------------------
+
+def wmr_map_reduce(map_fn: Callable, combine: Callable, mesh: Mesh, *,
+                   axis: str = "part"):
+    """Build ``f(data, valid) -> result`` where ``data`` is one window's content
+    [L, ...] sharded over ``axis`` in ``map_parallelism = mesh.shape[axis]``
+    partitions. Each device runs ``map_fn(partition, valid)`` on its local slice
+    (the reference MAP stage, role MAP), then the partials are tree-combined across
+    the axis with an all-reduce built from ``combine`` (the REDUCE stage; for
+    ``combine=jnp.add`` this is exactly ``lax.psum`` over ICI).
+
+    ``map_fn``: (local_data [L/p, ...], local_valid [L/p]) -> partial (any pytree of
+    arrays with matching shapes across devices). ``combine``: (partial, partial) ->
+    partial, associative."""
+    known = combine in (jnp.add, jnp.maximum, jnp.minimum)
+
+    def _allreduce(x):
+        if combine is jnp.add:
+            return jax.lax.psum(x, axis)
+        if combine is jnp.maximum:
+            return jax.lax.pmax(x, axis)
+        if combine is jnp.minimum:
+            return jax.lax.pmin(x, axis)
+        # generic associative combine: all_gather + order-preserving tree fold
+        # (adjacent pairs so non-commutative combines see partials in axis order;
+        # vmap keeps the user combine strictly pairwise — (partial, partial))
+        g = jax.lax.all_gather(x, axis)          # [p, ...]
+        n = g.shape[0]
+        while n > 1:
+            m = n // 2
+            paired = jax.vmap(combine)(g[0:2 * m:2], g[1:2 * m:2])
+            g = (jnp.concatenate([paired, g[2 * m:n]], axis=0)
+                 if n > 2 * m else paired)
+            n = m + (n - 2 * m)
+        return g[0]
+
+    def local(data, valid):
+        partial = map_fn(data, valid)
+        return jax.tree.map(_allreduce, partial)
+
+    # the folded all_gather of the generic path is replicated by construction, but
+    # the static varying-axes checker can't prove it — disable the check there
+    return _shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=P(), check_vma=known)
+
+
+# -- ring pane exchange ----------------------------------------------------------------
+
+def ring_pane_windows(combine: Callable, identity, mesh: Mesh, *,
+                      win_panes: int, slide_panes: int, axis: str = "win"):
+    """Build ``f(panes [Ptot], pane_valid [Ptot]) -> (win_results, win_valid)`` for
+    sliding windows of ``win_panes`` pane partials sliding by ``slide_panes``, with
+    the pane axis sharded in contiguous blocks over ``axis``.
+
+    Each device owns panes [d*B, (d+1)*B). A window starting in block d can extend
+    ``win_panes - 1`` panes into successor blocks, so the ring rotates each block to
+    its left neighbour ``ceil((win_panes-1)/B)`` times via ``ppermute``; the device
+    appends the halo and computes its windows locally — O(halo) bytes over ICI per
+    step, full pane axis never gathered. Window starts are global multiples of
+    ``slide_panes``; each window is emitted by the device whose block contains its
+    start pane — the WF_Emitter ownership rule applied to a sharded pane axis, and
+    the emitted window set is identical to the single-device computation regardless
+    of the device count.
+
+    Only windows fully covered by panes present on the ring are valid (trailing
+    windows whose halo would wrap past the end of the pane axis are masked, and the
+    wrap-around halo from device 0 is marked invalid)."""
+    p = _axis_size(mesh, axis)
+
+    def local(panes, pane_valid):
+        B = panes.shape[0]
+        halo_steps = -(-(win_panes - 1) // B) if win_panes > 1 else 0
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i - 1) % p) for i in range(p)]     # send left = pull from right
+        ext, ext_valid = panes, pane_valid
+        blk, blk_valid = panes, pane_valid
+        for s in range(halo_steps):
+            blk = jax.lax.ppermute(blk, axis, perm)
+            blk_valid = jax.lax.ppermute(blk_valid, axis, perm)
+            # block received on step s originates from device idx+s+1: wrapped if
+            # idx+s+1 >= p (those panes don't exist — mask them off)
+            wrapped = idx + s + 1 >= p
+            ext = jnp.concatenate([ext, blk], axis=0)
+            ext_valid = jnp.concatenate(
+                [ext_valid, jnp.where(wrapped, False, blk_valid)], axis=0)
+        # windows start at GLOBAL pane indices that are multiples of slide_panes;
+        # this device owns the ones falling inside its block [idx*B, (idx+1)*B).
+        # First owned start as a local offset (0..slide-1), then every slide after
+        # it; nwin is the worst-case count, extras masked by (start < B).
+        base = idx.astype(jnp.int32) * B
+        off = (-base) % slide_panes
+        nwin = (B + slide_panes - 1) // slide_panes
+        starts = off + jnp.arange(nwin, dtype=jnp.int32) * slide_panes
+
+        def one(start):
+            sl = jax.lax.dynamic_slice_in_dim(ext, start, win_panes, axis=0)
+            vl = jax.lax.dynamic_slice_in_dim(ext_valid, start, win_panes, axis=0)
+            masked = jnp.where(vl.reshape(vl.shape + (1,) * (sl.ndim - 1)),
+                               sl, identity)
+            res = masked[0]
+            for i in range(1, win_panes):
+                res = combine(res, masked[i])
+            return res, jnp.all(vl) & (start < B)
+        res, valid = jax.vmap(one)(starts)
+        return res, valid
+
+    return _shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=(P(axis), P(axis)))
+
+
+# -- keyed all-to-all ------------------------------------------------------------------
+
+def keyed_all_to_all(mesh: Mesh, *, axis: str = "key", capacity: int | None = None):
+    """Build ``f(keys [C], valid [C], payload pytree of [C, ...]) ->
+    (keys, valid, payload)`` redistributing every live row to the device that owns
+    its key (owner = key % n_devices), over one ``lax.all_to_all``.
+
+    Per (source, destination) lane budget is ``capacity`` rows (default C // p);
+    each source compacts its rows per destination into [p, capacity] sub-batches
+    (the ``create_sub_batch`` compaction of ``wf/standard_nodes_gpu.hpp``, done with
+    a rank-within-destination scatter), exchanges, and flattens back to a [p*cap]
+    local batch with a validity mask. Overflowing rows beyond the lane budget are
+    dropped — size the capacity like any bounded queue (backpressure discipline)."""
+    p = _axis_size(mesh, axis)
+
+    def local(keys, valid, payload):
+        C = keys.shape[0]
+        cap = capacity if capacity is not None else C // p
+        dest = jnp.where(valid, keys % p, p)            # p = parked lane
+        # rank of each row among live rows with the same destination (stream order)
+        rank = segment_rank(dest, valid)
+        # scatter rows into [p, cap] slots per destination
+        slot_ok = valid & (rank < cap)
+        flat_slot = jnp.where(slot_ok, dest * cap + rank, p * cap)
+
+        def place(arr, fill=0):
+            out = jnp.full((p * cap + 1,) + arr.shape[1:], fill, arr.dtype)
+            out = out.at[flat_slot].set(arr)
+            return out[:p * cap].reshape((p, cap) + arr.shape[1:])
+
+        sub_keys = place(keys)
+        sub_valid = place(slot_ok.astype(jnp.int32)).astype(jnp.bool_)
+        sub_pay = jax.tree.map(place, payload)
+        # exchange: axis 0 is the destination axis
+        ex = lambda a: jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
+                                          tiled=False)
+        rk, rv = ex(sub_keys), ex(sub_valid)
+        rp = jax.tree.map(ex, sub_pay)
+        flat = lambda a: a.reshape((p * cap,) + a.shape[2:])
+        return flat(rk), flat(rv), jax.tree.map(flat, rp)
+
+    return _shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+                      out_specs=(P(axis), P(axis), P(axis)))
