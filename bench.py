@@ -120,6 +120,70 @@ def bench_keyed_cb():
     return STEPS * BATCH / dt, dt / STEPS
 
 
+def bench_keyed_stateful(num_keys: int):
+    """MapGPU-stateful analogue (BASELINE.md rows 3-5): keyed map with a per-key
+    running state folded in stream order (the reference keeps a per-key device
+    scratch, wf/map_gpu_node.hpp:216-222). Sweep num_keys to reproduce the
+    1-key serialization floor / 500-key peak / 10k-key curve."""
+    import jax
+    import jax.numpy as jnp
+    from windflow_tpu.operators.accumulator import Accumulator
+    from windflow_tpu.operators.sink import ReduceSink
+    from windflow_tpu.operators.source import DeviceSource
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    src = DeviceSource(lambda i: {"v": (i % 1000).astype(jnp.float32)},
+                       total=(STEPS + 2) * BATCH, num_keys=num_keys)
+    # per-key running state folded in stream order: the associative formulation
+    # (segmented prefix scan + HBM carry table) — the TPU-native equivalent of the
+    # reference's sequential per-key scratch update; no serialization floor at K=1
+    ops = [Accumulator(lambda t: t.data["v"], init_value=0.0,
+                       num_keys=max(num_keys, 8)),
+           ReduceSink(lambda t: t.data)]
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+
+    def step(states, start):
+        batch = src.make_batch(jnp.asarray(start, jnp.int32), BATCH)
+        states = list(states)
+        for j, o in enumerate(chain.ops):
+            states[j], batch = o.apply(states[j], batch)
+        return tuple(states), batch.valid
+
+    step = jax.jit(step, donate_argnums=0)
+    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH)
+    return STEPS * BATCH / dt, dt / STEPS
+
+
+def bench_scatter(fanout: int):
+    """Keyed-scatter emitter analogue (BASELINE.md row 9, scattering study):
+    partition each batch into per-destination sub-batches on device."""
+    import jax
+    import jax.numpy as jnp
+    from windflow_tpu.ops.compaction import partition_by_destination
+
+    cap = 2 * BATCH // fanout
+
+    @jax.jit
+    def step(start):
+        i = start + jnp.arange(BATCH, dtype=jnp.int32)
+        key = (i.astype(jnp.uint32) * jnp.uint32(2654435761) % 10007).astype(jnp.int32)
+        dest = key % fanout
+        valid = jnp.ones((BATCH,), jnp.bool_)
+        gather_idx, out_valid = partition_by_destination(dest, valid, fanout, cap)
+        v = (i % 1000).astype(jnp.float32)
+        sub = jnp.take(v, gather_idx)              # [fanout, cap] sub-batch payloads
+        return jnp.sum(jnp.where(out_valid, sub, 0.0))
+
+    out = step(0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for s in range(1, STEPS + 1):
+        out = step(s * BATCH)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return STEPS * BATCH / dt, dt / STEPS
+
+
 def bench_ingest():
     """Host->device ingestion path (GeneratorSource analogue): numpy batches
     device_put + map+filter. Measures the H2D-inclusive throughput."""
@@ -171,6 +235,16 @@ def main():
         in_tps, in_step = bench_ingest()
         print(f"host ingest (H2D + map+filter): {in_tps/1e6:.2f} M tuples/s "
               f"({in_step*1e3:.2f} ms/step)", file=sys.stderr)
+        for k in (1, 500, 10000):
+            ks_tps, ks_step = bench_keyed_stateful(k)
+            print(f"keyed-stateful map (K={k}): {ks_tps/1e6:.2f} M tuples/s "
+                  f"({ks_step*1e3:.2f} ms/step)  [CUDA bar: 0.44-0.64M @1, "
+                  f"11.8M @500, 10M @10k]", file=sys.stderr)
+        for n in (2, 4, 8, 16):
+            sc_tps, sc_step = bench_scatter(n)
+            print(f"keyed scatter fan-out={n}: {sc_tps/1e6:.2f} M tuples/s "
+                  f"({sc_step*1e3:.2f} ms/step)  [CUDA bar: 1.6M @2 -> "
+                  f"0.2-0.7M @16]", file=sys.stderr)
 
     print(json.dumps({
         "metric": "YSB tuples/sec/chip",

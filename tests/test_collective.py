@@ -67,6 +67,27 @@ def test_wmr_pmax_and_generic_combine(mesh):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
 
 
+def test_wmr_generic_combine_pytree_partials(mesh):
+    # mean via (sum, count) pytree partials — the documented '(partial, partial) ->
+    # partial, any pytree' contract of the generic combine path
+    L = 64
+    data = jnp.asarray(np.random.default_rng(3).normal(size=L), jnp.float32)
+    valid = jnp.arange(L) % 3 != 0
+
+    def map_fn(local, lv):
+        return {"s": jnp.sum(jnp.where(lv, local, 0.0)),
+                "n": jnp.sum(lv.astype(jnp.float32))}
+
+    def combine(a, b):
+        return {"s": a["s"] + b["s"], "n": a["n"] + b["n"]}
+
+    got = jax.jit(wmr_map_reduce(map_fn, combine, mesh, axis="part"))(data, valid)
+    want_s = float(jnp.sum(jnp.where(valid, data, 0.0)))
+    want_n = float(jnp.sum(valid))
+    np.testing.assert_allclose(float(got["s"]), want_s, rtol=1e-5)
+    assert float(got["n"]) == want_n
+
+
 @pytest.mark.parametrize("win_panes,slide_panes",
                          [(4, 2), (8, 4), (3, 1), (9, 3), (5, 3), (7, 5), (11, 2)])
 def test_ring_pane_windows_matches_dense(win_panes, slide_panes):

@@ -44,6 +44,12 @@ try:                                     # jax >= 0.4.35
 except ImportError:                      # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+import inspect as _inspect
+
+#: the replication-check kwarg was renamed check_rep -> check_vma across jax versions
+_CHECK_KW = ("check_vma" if "check_vma" in _inspect.signature(_shard_map).parameters
+             else "check_rep")
+
 
 def _axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
@@ -63,36 +69,39 @@ def wmr_map_reduce(map_fn: Callable, combine: Callable, mesh: Mesh, *,
     ``map_fn``: (local_data [L/p, ...], local_valid [L/p]) -> partial (any pytree of
     arrays with matching shapes across devices). ``combine``: (partial, partial) ->
     partial, associative."""
+    p = _axis_size(mesh, axis)
     known = combine in (jnp.add, jnp.maximum, jnp.minimum)
-
-    def _allreduce(x):
-        if combine is jnp.add:
-            return jax.lax.psum(x, axis)
-        if combine is jnp.maximum:
-            return jax.lax.pmax(x, axis)
-        if combine is jnp.minimum:
-            return jax.lax.pmin(x, axis)
-        # generic associative combine: all_gather + order-preserving tree fold
-        # (adjacent pairs so non-commutative combines see partials in axis order;
-        # vmap keeps the user combine strictly pairwise — (partial, partial))
-        g = jax.lax.all_gather(x, axis)          # [p, ...]
-        n = g.shape[0]
-        while n > 1:
-            m = n // 2
-            paired = jax.vmap(combine)(g[0:2 * m:2], g[1:2 * m:2])
-            g = (jnp.concatenate([paired, g[2 * m:n]], axis=0)
-                 if n > 2 * m else paired)
-            n = m + (n - 2 * m)
-        return g[0]
+    reducer = {jnp.add: jax.lax.psum, jnp.maximum: jax.lax.pmax,
+               jnp.minimum: jax.lax.pmin}.get(combine)
 
     def local(data, valid):
         partial = map_fn(data, valid)
-        return jax.tree.map(_allreduce, partial)
+        if known:
+            return jax.tree.map(lambda x: reducer(x, axis), partial)
+        # generic associative combine: all_gather + order-preserving tree fold.
+        # The fold runs at the PYTREE level (combine sees whole partials, strictly
+        # pairwise via vmap), pairing adjacent elements so non-commutative combines
+        # see partials in axis order.
+        g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis), partial)
+        n = p
+        while n > 1:
+            m = n // 2
+            a = jax.tree.map(lambda x: x[0:2 * m:2], g)
+            b = jax.tree.map(lambda x: x[1:2 * m:2], g)
+            paired = jax.vmap(combine)(a, b)
+            if n > 2 * m:
+                rest = jax.tree.map(lambda x: x[2 * m:n], g)
+                g = jax.tree.map(lambda pr, r: jnp.concatenate([pr, r], axis=0),
+                                 paired, rest)
+            else:
+                g = paired
+            n = m + (n - 2 * m)
+        return jax.tree.map(lambda x: x[0], g)
 
     # the folded all_gather of the generic path is replicated by construction, but
     # the static varying-axes checker can't prove it — disable the check there
     return _shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
-                      out_specs=P(), check_vma=known)
+                      out_specs=P(), **{_CHECK_KW: known})
 
 
 # -- ring pane exchange ----------------------------------------------------------------
@@ -120,20 +129,26 @@ def ring_pane_windows(combine: Callable, identity, mesh: Mesh, *,
 
     def local(panes, pane_valid):
         B = panes.shape[0]
-        halo_steps = -(-(win_panes - 1) // B) if win_panes > 1 else 0
         idx = jax.lax.axis_index(axis)
         perm = [(i, (i - 1) % p) for i in range(p)]     # send left = pull from right
+        # per-step halo widths: step s ships the first min(B, remaining) panes of
+        # block idx+s+1 — only the panes windows can actually read, so ICI traffic
+        # is O(win_panes) total, not O(B) per step
+        widths, rem = [], max(win_panes - 1, 0)
+        while rem > 0:
+            widths.append(min(B, rem))
+            rem -= widths[-1]
         ext, ext_valid = panes, pane_valid
-        blk, blk_valid = panes, pane_valid
-        for s in range(halo_steps):
-            blk = jax.lax.ppermute(blk, axis, perm)
-            blk_valid = jax.lax.ppermute(blk_valid, axis, perm)
-            # block received on step s originates from device idx+s+1: wrapped if
-            # idx+s+1 >= p (those panes don't exist — mask them off)
+        buf, buf_valid = panes, pane_valid
+        for s, w in enumerate(widths):                  # widths are non-increasing
+            buf = jax.lax.ppermute(buf[:w], axis, perm)
+            buf_valid = jax.lax.ppermute(buf_valid[:w], axis, perm)
+            # buffer received on step s holds the leading panes of block idx+s+1:
+            # wrapped past the end of the pane axis if idx+s+1 >= p — mask off
             wrapped = idx + s + 1 >= p
-            ext = jnp.concatenate([ext, blk], axis=0)
+            ext = jnp.concatenate([ext, buf], axis=0)
             ext_valid = jnp.concatenate(
-                [ext_valid, jnp.where(wrapped, False, blk_valid)], axis=0)
+                [ext_valid, jnp.where(wrapped, False, buf_valid)], axis=0)
         # windows start at GLOBAL pane indices that are multiples of slide_panes;
         # this device owns the ones falling inside its block [idx*B, (idx+1)*B).
         # First owned start as a local offset (0..slide-1), then every slide after
