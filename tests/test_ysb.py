@@ -1,0 +1,57 @@
+"""YSB (flagship macro-benchmark) correctness: the sum of all emitted window counts
+must equal the number of view events in the stream (reference oracle: the sink
+accumulates per-window counts, src/yahoo_test_cpu/test_ysb_kf.cpp), invariant under
+batch size and across the KF (Key_FFAT) and WMR (Win_MapReduce) window variants."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.benchmarks import ysb
+
+TOTAL = 3000        # 300 time units = 3 windows per campaign
+
+
+def run_variant(make_ops_fn, batch_size, **kw):
+    src = ysb.make_source(TOTAL)
+    ops = make_ops_fn(**kw)
+    results = []
+
+    def cb(view):
+        if view is None:
+            return
+        for k, w, c in zip(view["key"].tolist(), view["id"].tolist(),
+                           np.asarray(view["payload"]).tolist()):
+            results.append((int(k), int(w), int(c)))
+
+    wf.Pipeline(src, ops, wf.Sink(cb), batch_size=batch_size).run()
+    return sorted(results)
+
+
+@pytest.mark.parametrize("batch_size", [256, 1000, TOTAL])
+def test_ysb_kf_totals_match_oracle(batch_size):
+    res = run_variant(ysb.make_ops, batch_size)
+    assert res, "no window results emitted"
+    assert sum(c for _, _, c in res) == ysb.oracle_totals(TOTAL)
+
+
+def test_ysb_wmr_matches_kf_windows():
+    kf = run_variant(ysb.make_ops, 500)
+    wmr = run_variant(ysb.make_ops_wmr, 500, map_parallelism=2)
+    assert kf == wmr
+    wmr3 = run_variant(ysb.make_ops_wmr, 750, map_parallelism=3)
+    assert kf == wmr3
+
+
+def test_ysb_per_window_counts_against_dense_oracle():
+    res = run_variant(ysb.make_ops, 512)
+    want = {}
+    for i in range(TOTAL):
+        if i % 3 != 0:                          # filter: views only
+            continue
+        camp = (i * 7919) % ysb.N_ADS // ysb.ADS_PER_CAMPAIGN
+        wid = (i // ysb.EVENTS_PER_TICK) // ysb.WIN_LEN
+        want[(camp, wid)] = want.get((camp, wid), 0) + 1
+    got = {(k, w): c for k, w, c in res}
+    assert got == want

@@ -72,6 +72,21 @@ def make_ops(num_keys: int = N_CAMPAIGNS, win_len: int = WIN_LEN,
     return [filt, join, rekey, window]
 
 
+def make_ops_wmr(num_keys: int = N_CAMPAIGNS, win_len: int = WIN_LEN,
+                 map_parallelism: int = 2):
+    """YSB with a Win_MapReduce window stage — the ``test_ysb_wmr.cpp`` variant of
+    the reference (each window's content partitioned over MAP workers, partial
+    counts combined by REDUCE)."""
+    from ..operators.win_patterns import Win_MapReduce
+    filt, join, rekey, _ = make_ops(num_keys=num_keys, win_len=win_len)
+    window = Win_MapReduce(lambda wid, it: it.size(),
+                           lambda wid, it: it.sum(),
+                           WindowSpec(win_len, win_len, win_type_t.TB),
+                           map_parallelism=map_parallelism, num_keys=num_keys,
+                           name="ysb_window_wmr")
+    return [filt, join, rekey, window]
+
+
 def make_source(total: int, name: str = "ysb_source") -> DeviceSource:
     def gen(i):
         return {"ad_id": (i * 7919) % N_ADS,     # pseudo-random ad
