@@ -15,6 +15,7 @@ from .batch import Batch, TupleRef, tuple_refs, concat_batches
 from .context import RuntimeContext, LocalStorage
 from .shipper import Shipper
 from .operators import (Basic_Operator, Source, DeviceSource, GeneratorSource,
+                        RecordSource,
                         Map, KeyedMap, Filter, FilterMap, Compact, FlatMap,
                         Accumulator, Sink, ReduceSink)
 from .operators.map import BatchMap

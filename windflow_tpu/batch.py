@@ -167,6 +167,13 @@ def hash_key_to_slot(key, num_slots: int):
         k = int(key) & 0xFFFFFFFFFFFFFFFF
         return int((k * 2654435761) % (1 << 64) % num_slots)
     arr = np.asarray(key)
+    if arr.dtype.kind in "USiu" and arr.ndim > 0:
+        # array path: one native C pass when the library is built (bit-identical
+        # FNV-1a / Knuth arithmetic, windflow_tpu/native/ingest.cpp)
+        from .native import hash_keys_native
+        slots = hash_keys_native(arr, num_slots)
+        if slots is not None:
+            return slots
     if arr.dtype.kind in "USO":                        # strings / bytes / objects
         # hash each distinct key once (batches typically repeat few keys)
         uniq, inv = np.unique(arr.ravel(), return_inverse=True)

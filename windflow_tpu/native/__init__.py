@@ -19,20 +19,36 @@ _SO = os.path.join(_DIR, "libwfnative.so")
 _lib = None
 
 
+def _build():
+    try:
+        subprocess.run(["make", "-C", _DIR, "clean", "all"], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO):
-        try:
-            subprocess.run(["make", "-C", _DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
-            return None
+    if not os.path.exists(_SO) and not _build():
+        return None
     try:
         lib = ctypes.CDLL(_SO)
     except OSError:
         return None
+    if not hasattr(lib, "wf_unpack_records"):
+        # stale .so from an older source set: rebuild once, else fall back
+        del lib
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        if not hasattr(lib, "wf_unpack_records"):
+            return None
     lib.wf_queue_create.restype = ctypes.c_void_p
     lib.wf_queue_create.argtypes = [ctypes.c_uint64]
     lib.wf_queue_destroy.argtypes = [ctypes.c_void_p]
@@ -52,6 +68,21 @@ def _load():
     lib.wf_pin_thread.restype = ctypes.c_int
     lib.wf_pin_thread.argtypes = [ctypes.c_int]
     lib.wf_hardware_concurrency.restype = ctypes.c_int
+    _p = ctypes.POINTER
+    lib.wf_unpack_records.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+        _p(ctypes.c_uint64), _p(ctypes.c_uint64), _p(ctypes.c_char_p)]
+    lib.wf_pack_records.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+        _p(ctypes.c_uint64), _p(ctypes.c_uint64), _p(ctypes.c_char_p)]
+    lib.wf_hash_str_keys.argtypes = [
+        ctypes.c_char_p, _p(ctypes.c_int64), ctypes.c_uint64, ctypes.c_uint32,
+        _p(ctypes.c_int32)]
+    lib.wf_hash_fixed_str_keys.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint32, _p(ctypes.c_int32)]
+    lib.wf_hash_int_keys.argtypes = [
+        _p(ctypes.c_int64), ctypes.c_uint64, ctypes.c_uint32, _p(ctypes.c_int32)]
     _lib = lib
     return lib
 
@@ -114,6 +145,114 @@ class SPSCQueue:
         if getattr(self, "_q", None) is not None and self._lib is not None:
             self._lib.wf_queue_destroy(self._q)
             self._q = None
+
+
+def unpack_records(records, fields=None):
+    """AoS -> SoA in one native pass: ``records`` is a numpy structured array
+    (the framing of network/disk ingest); returns ``{field: contiguous column}``.
+    The native counterpart of the reference's per-tuple Source/Shipper copy path
+    (``wf/source.hpp:184``, ``wf/shipper.hpp:87``). Falls back to numpy per-field
+    copies when the native library is unavailable."""
+    import numpy as np
+    lib = _load()
+    dt = records.dtype
+    names = list(fields if fields is not None else dt.names)
+    if lib is None or not records.flags["C_CONTIGUOUS"]:
+        return {f: np.ascontiguousarray(records[f]) for f in names}
+    n = records.shape[0]
+    outs, dsts, offs, szs = {}, [], [], []
+    for f in names:
+        fdt, off = dt.fields[f][0], dt.fields[f][1]
+        col = np.empty(n, fdt)
+        outs[f] = col
+        dsts.append(col.ctypes.data_as(ctypes.c_char_p))
+        offs.append(off)
+        szs.append(fdt.itemsize)
+    nf = len(names)
+    lib.wf_unpack_records(
+        records.ctypes.data_as(ctypes.c_char_p), n, dt.itemsize, nf,
+        (ctypes.c_uint64 * nf)(*offs), (ctypes.c_uint64 * nf)(*szs),
+        (ctypes.c_char_p * nf)(*dsts))
+    # structured subdtypes (e.g. ('f4', (3,))) come back flat; reshape
+    for f in names:
+        sub = dt.fields[f][0]
+        if sub.subdtype is not None:
+            outs[f] = outs[f].view(sub.subdtype[0]).reshape((n,) + sub.subdtype[1])
+    return outs
+
+
+def pack_records(columns: dict, dtype):
+    """SoA -> AoS egress (sinks emitting framed records): inverse of
+    :func:`unpack_records`."""
+    import numpy as np
+    lib = _load()
+    names = list(dtype.names)
+    n = len(np.asarray(columns[names[0]]))
+    out = np.empty(n, dtype)
+    if lib is None:
+        for f in names:
+            out[f] = columns[f]
+        return out
+    srcs, offs, szs = [], [], []
+    cols = []
+    for f in names:
+        fdt, off = dtype.fields[f][0], dtype.fields[f][1]
+        col = np.ascontiguousarray(np.asarray(columns[f]), fdt.base if fdt.subdtype else fdt)
+        if col.nbytes != n * fdt.itemsize:
+            raise ValueError(
+                f"pack_records: column '{f}' has {col.shape} {col.dtype} "
+                f"({col.nbytes} bytes) but field needs {n} x {fdt.itemsize} bytes")
+        cols.append(col)                         # keep alive
+        srcs.append(col.ctypes.data_as(ctypes.c_char_p))
+        offs.append(off)
+        szs.append(fdt.itemsize)
+    nf = len(names)
+    lib.wf_pack_records(
+        out.ctypes.data_as(ctypes.c_char_p), n, dtype.itemsize, nf,
+        (ctypes.c_uint64 * nf)(*offs), (ctypes.c_uint64 * nf)(*szs),
+        (ctypes.c_char_p * nf)(*srcs))
+    return out
+
+
+def hash_keys_native(keys, num_slots: int):
+    """Native key->slot hashing, bit-identical to
+    ``windflow_tpu.batch.hash_key_to_slot``: 32-bit FNV-1a for string/bytes arrays,
+    Knuth uint64 multiply for integer arrays. Returns int32 slots, or None when the
+    native library is unavailable (caller falls back to the Python path)."""
+    import numpy as np
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.asarray(keys)
+    out = np.empty(arr.size, np.int32)
+    if arr.dtype.kind in "iu":
+        k = np.ascontiguousarray(arr.ravel().astype(np.int64))
+        lib.wf_hash_int_keys(k.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                             arr.size, num_slots,
+                             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out.reshape(arr.shape)
+    if arr.dtype.kind == "S":
+        a = np.ascontiguousarray(arr.ravel())
+        lib.wf_hash_fixed_str_keys(
+            a.ctypes.data_as(ctypes.c_char_p), a.size, a.dtype.itemsize,
+            a.dtype.itemsize, num_slots,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out.reshape(arr.shape)
+    if arr.dtype.kind == "U":
+        # dedup first (batches typically repeat few keys), hash uniques natively,
+        # scatter back through the inverse index
+        uniq, inv = np.unique(arr.ravel(), return_inverse=True)
+        enc = [s.encode() for s in uniq.tolist()]
+        buf = b"".join(enc)
+        offsets = np.zeros(len(enc) + 1, np.int64)
+        np.cumsum([len(e) for e in enc], out=offsets[1:])
+        uout = np.empty(len(enc), np.int32)
+        lib.wf_hash_str_keys(
+            buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(enc), num_slots,
+            uout.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return uout[inv].reshape(arr.shape)
+    return None
 
 
 def pin_thread(core: int) -> bool:

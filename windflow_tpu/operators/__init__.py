@@ -1,5 +1,5 @@
 from .base import Basic_Operator
-from .source import Source, DeviceSource, GeneratorSource, SourceBase
+from .source import Source, DeviceSource, GeneratorSource, RecordSource, SourceBase
 from .map import Map, KeyedMap
 from .filter import Filter, FilterMap, Compact
 from .flatmap import FlatMap
@@ -7,7 +7,7 @@ from .accumulator import Accumulator
 from .sink import Sink, ReduceSink
 
 __all__ = [
-    "Basic_Operator", "Source", "DeviceSource", "GeneratorSource", "SourceBase",
+    "Basic_Operator", "Source", "DeviceSource", "GeneratorSource", "RecordSource", "SourceBase",
     "Map", "KeyedMap", "Filter", "FilterMap", "Compact", "FlatMap",
     "Accumulator", "Sink", "ReduceSink",
 ]

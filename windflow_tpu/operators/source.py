@@ -43,6 +43,29 @@ class SourceBase(Basic_Operator):
     def payload_spec(self) -> Any:
         raise NotImplementedError
 
+    def _frame(self, payload, key, ts, n: int, batch_size: int,
+               next_id: int) -> Batch:
+        """Shared host-batch assembly: zero-pad every column to ``batch_size``,
+        assign progressive ids, mask the tail. ``payload`` is a pytree of numpy
+        arrays with leading size ``n``; ``key``/``ts`` are [n] arrays or None."""
+        if n > batch_size:
+            raise ValueError(f"{self.name}: chunk of {n} tuples > "
+                             f"batch_size={batch_size}")
+        pad = batch_size - n
+
+        def pad_to(a):
+            a = np.asarray(a)
+            return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+        ids = np.arange(next_id, next_id + batch_size, dtype=np.int32)
+        return Batch(
+            key=jnp.asarray(pad_to(key).astype(np.int32) if key is not None
+                            else np.zeros(batch_size, np.int32)),
+            id=jnp.asarray(ids),
+            ts=jnp.asarray(pad_to(ts).astype(np.int32) if ts is not None else ids),
+            payload=jax.tree.map(lambda a: jnp.asarray(pad_to(a)), payload),
+            valid=jnp.asarray(np.arange(batch_size) < n),
+        )
+
 
 class DeviceSource(SourceBase):
     """Synthetic on-device source: ``payload = vmap(f)(global_index)``.
@@ -130,22 +153,73 @@ class GeneratorSource(SourceBase):
             else:
                 payload, key, ts = item, None, None
             n = np.shape(jax.tree.leaves(payload)[0])[0]
-            if n > batch_size:
-                raise ValueError(f"generator yielded {n} > batch_size={batch_size}")
-            pad = batch_size - n
-
-            def pad_to(a):
-                a = np.asarray(a)
-                return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
-            ids = np.arange(next_id, next_id + batch_size, dtype=np.int32)
+            yield self._frame(payload, key, ts, n, batch_size, next_id)
             next_id += n
-            yield Batch(
-                key=jnp.asarray(pad_to(key) if key is not None else np.zeros(batch_size, np.int32)),
-                id=jnp.asarray(ids),
-                ts=jnp.asarray(pad_to(ts) if ts is not None else ids),
-                payload=jax.tree.map(lambda a: jnp.asarray(pad_to(a)), payload),
-                valid=jnp.asarray(np.arange(batch_size) < n),
-            )
+
+
+class RecordSource(SourceBase):
+    """AoS record ingest: wraps an iterator of numpy *structured arrays* (the framing
+    of network/disk streams — one record per row) and transposes each chunk to SoA
+    columns in one native C pass (``windflow_tpu/native/ingest.cpp::wf_unpack_records``
+    — the counterpart of the reference's per-tuple Source/Shipper copy,
+    ``wf/source.hpp:184``). Control fields come from named record fields:
+    ``key_field`` (hashed to ``[0, num_keys)`` natively when non-integer),
+    ``ts_field`` (default: tuple index). Remaining fields become the payload."""
+
+    def __init__(self, it_factory: Callable[[], Iterator[np.ndarray]],
+                 record_dtype: np.dtype, *, key_field: Optional[str] = None,
+                 ts_field: Optional[str] = None, num_keys: Optional[int] = None,
+                 name: str = "record_source", parallelism: int = 1):
+        super().__init__(name, parallelism)
+        self.it_factory = it_factory
+        self.dtype = np.dtype(record_dtype)
+        self.key_field = key_field
+        self.ts_field = ts_field
+        self.num_keys = num_keys
+        self.payload_fields = [f for f in self.dtype.names
+                               if f not in (key_field, ts_field)]
+        if not self.payload_fields:
+            raise ValueError(f"{name}: no payload fields left in {self.dtype}")
+        for f in self.payload_fields:
+            fdt = self.dtype.fields[f][0]
+            base = fdt.subdtype[0] if fdt.subdtype else fdt
+            if base.kind not in "biufc":
+                raise TypeError(
+                    f"{name}: payload field '{f}' has dtype {base} — only numeric/"
+                    f"bool fields can become device arrays (route string fields "
+                    f"through key_field=, or drop them from the record dtype)")
+
+    def payload_spec(self):
+        spec = {}
+        for f in self.payload_fields:
+            fdt = self.dtype.fields[f][0]
+            base, shape = ((fdt.subdtype[0], fdt.subdtype[1]) if fdt.subdtype
+                           else (fdt, ()))
+            spec[f] = jax.ShapeDtypeStruct(shape, jnp.dtype(base))
+        return spec
+
+    def _key_slots(self, col: np.ndarray) -> np.ndarray:
+        if self.num_keys is not None:
+            return np.asarray(hash_key_to_slot(col, self.num_keys))
+        if col.dtype.kind not in "iu":
+            raise TypeError(
+                f"{self.name}: non-integer key field '{self.key_field}' "
+                f"(dtype {col.dtype}) requires num_keys=N for hashing")
+        return col.astype(np.int32)
+
+    def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
+        from ..native import unpack_records
+        next_id = 0
+        for rec in self.it_factory():
+            rec = np.asarray(rec, self.dtype)
+            n = rec.shape[0]
+            cols = unpack_records(rec)
+            key = (self._key_slots(cols[self.key_field])
+                   if self.key_field else None)
+            ts = cols[self.ts_field] if self.ts_field else None
+            payload = {f: cols[f] for f in self.payload_fields}
+            yield self._frame(payload, key, ts, n, batch_size, next_id)
+            next_id += n
 
 
 # reference-style alias
