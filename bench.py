@@ -120,6 +120,45 @@ def bench_keyed_cb():
     return STEPS * BATCH / dt, dt / STEPS
 
 
+def bench_ysb_latency(batch: int = 1 << 16, steps: int = 60):
+    """p99 window-result latency: per-batch blocking latency through the full YSB
+    chain at a latency-oriented batch size. Each step is synchronized (no pipeline
+    overlap), so a step's wall time bounds the time from a tuple entering the chain
+    to its window result leaving — the p99 of the north-star metric."""
+    import jax
+    import jax.numpy as jnp
+    from windflow_tpu.benchmarks import ysb
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    panes_per_batch = max(batch // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN), 1) + 1
+    src = ysb.make_source(total=(steps + 2) * batch)
+    ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
+                       max_wins=panes_per_batch + 64)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
+
+    def step(states, start):
+        b = src.make_batch(jnp.asarray(start, jnp.int32), batch)
+        states = list(states)
+        for j, op in enumerate(chain.ops):
+            states[j], b = op.apply(states[j], b)
+        return tuple(states), b.valid
+
+    step = jax.jit(step, donate_argnums=0)
+    states = tuple(chain.states)
+    states, out = step(states, 0)
+    jax.block_until_ready(out)
+    lat = []
+    for i in range(1, steps + 1):
+        t0 = time.perf_counter()
+        states, out = step(states, i * batch)
+        jax.block_until_ready(out)              # synchronous: true per-batch latency
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    return p50, p99, batch / (sum(lat) / len(lat))
+
+
 def bench_keyed_stateful(num_keys: int):
     """MapGPU-stateful analogue (BASELINE.md rows 3-5): keyed map with a per-key
     running state folded in stream order (the reference keeps a per-key device
@@ -225,8 +264,10 @@ def main():
           f"batch={BATCH})", file=sys.stderr)
     print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "
           f"({sl_step_s*1e3:.2f} ms/step)", file=sys.stderr)
-    print(f"window-result latency bound ~= step time: {ysb_step_s*1e3:.2f} ms",
-          file=sys.stderr)
+    lat_p50, lat_p99, lat_tps = bench_ysb_latency()
+    print(f"window-result latency (batch=65536, synchronous): "
+          f"p50 {lat_p50*1e3:.2f} ms, p99 {lat_p99*1e3:.2f} ms "
+          f"(at {lat_tps/1e6:.1f} M t/s)", file=sys.stderr)
     if os.environ.get("WF_BENCH_ALL"):
         kc_tps, kc_step = bench_keyed_cb()
         print(f"keyed CB sliding windows (K=512, w=1024 s=512): "

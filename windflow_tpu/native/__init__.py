@@ -19,36 +19,55 @@ _SO = os.path.join(_DIR, "libwfnative.so")
 _lib = None
 
 
+_load_failed = False            # sticky: a failed build/load is not retried per call
+
+
 def _build():
+    """Compile to a temp name and rename over the target only on success — a stale
+    but working .so is never destroyed by a failed rebuild."""
+    tmp = _SO + ".tmp"
     try:
-        subprocess.run(["make", "-C", _DIR, "clean", "all"], check=True,
-                       capture_output=True, timeout=120)
+        subprocess.run(["make", "-C", _DIR, f"TARGET={os.path.basename(tmp)}"],
+                       check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except Exception:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
 
 
 def _load():
-    global _lib
+    global _lib, _load_failed
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO) and not _build():
+    if _load_failed:
         return None
+
+    def fail():
+        global _load_failed
+        _load_failed = True
+        return None
+
+    if not os.path.exists(_SO) and not _build():
+        return fail()
     try:
         lib = ctypes.CDLL(_SO)
     except OSError:
-        return None
+        return fail()
     if not hasattr(lib, "wf_unpack_records"):
         # stale .so from an older source set: rebuild once, else fall back
         del lib
         if not _build():
-            return None
+            return fail()
         try:
             lib = ctypes.CDLL(_SO)
         except OSError:
-            return None
+            return fail()
         if not hasattr(lib, "wf_unpack_records"):
-            return None
+            return fail()
     lib.wf_queue_create.restype = ctypes.c_void_p
     lib.wf_queue_create.argtypes = [ctypes.c_uint64]
     lib.wf_queue_destroy.argtypes = [ctypes.c_void_p]
@@ -189,20 +208,26 @@ def pack_records(columns: dict, dtype):
     names = list(dtype.names)
     n = len(np.asarray(columns[names[0]]))
     out = np.empty(n, dtype)
-    if lib is None:
-        for f in names:
-            out[f] = columns[f]
-        return out
-    srcs, offs, szs = [], [], []
+    # validate every column against its field BEFORE any copy, native or not —
+    # same error either way, and no native out-of-bounds read
     cols = []
     for f in names:
-        fdt, off = dtype.fields[f][0], dtype.fields[f][1]
-        col = np.ascontiguousarray(np.asarray(columns[f]), fdt.base if fdt.subdtype else fdt)
+        fdt = dtype.fields[f][0]
+        col = np.ascontiguousarray(np.asarray(columns[f]),
+                                   fdt.base if fdt.subdtype else fdt)
         if col.nbytes != n * fdt.itemsize:
             raise ValueError(
                 f"pack_records: column '{f}' has {col.shape} {col.dtype} "
                 f"({col.nbytes} bytes) but field needs {n} x {fdt.itemsize} bytes")
-        cols.append(col)                         # keep alive
+        cols.append(col)                         # also keeps ctypes pointers alive
+    if lib is None:
+        for f, col in zip(names, cols):
+            sub = dtype.fields[f][0].subdtype
+            out[f] = col.reshape((n,) + sub[1]) if sub else col
+        return out
+    srcs, offs, szs = [], [], []
+    for f, col in zip(names, cols):
+        fdt, off = dtype.fields[f][0], dtype.fields[f][1]
         srcs.append(col.ctypes.data_as(ctypes.c_char_p))
         offs.append(off)
         szs.append(fdt.itemsize)

@@ -43,6 +43,23 @@ class SourceBase(Basic_Operator):
     def payload_spec(self) -> Any:
         raise NotImplementedError
 
+    def _ingest_key(self, key):
+        """Key -> slot policy shared by every host source: hash to [0, num_keys)
+        when ``num_keys`` is set (``hash(key) % n`` routing contract,
+        ``wf/standard_emitter.hpp:88-99``); otherwise keys must already be integer
+        slot indices."""
+        if key is None:
+            return None
+        num_keys = getattr(self, "num_keys", None)
+        if num_keys is not None:
+            return hash_key_to_slot(key, num_keys)
+        arr = np.asarray(key)
+        if arr.dtype.kind not in "iu":
+            raise TypeError(
+                f"{self.name}: non-integer keys (dtype {arr.dtype}) require "
+                f"num_keys=N to hash them into key slots")
+        return arr
+
     def _frame(self, payload, key, ts, n: int, batch_size: int,
                next_id: int) -> Batch:
         """Shared host-batch assembly: zero-pad every column to ``batch_size``,
@@ -126,18 +143,6 @@ class GeneratorSource(SourceBase):
         self._spec = spec
         self.num_keys = num_keys
 
-    def _ingest_key(self, key):
-        if key is None:
-            return None
-        if self.num_keys is not None:
-            return hash_key_to_slot(key, self.num_keys)
-        arr = np.asarray(key)
-        if arr.dtype.kind not in "iu":
-            raise TypeError(
-                f"{self.name}: non-integer keys (dtype {arr.dtype}) require "
-                "GeneratorSource(..., num_keys=N) to hash them into key slots")
-        return arr
-
     def payload_spec(self):
         return self._spec
 
@@ -173,6 +178,10 @@ class RecordSource(SourceBase):
         super().__init__(name, parallelism)
         self.it_factory = it_factory
         self.dtype = np.dtype(record_dtype)
+        for role, fname in (("key_field", key_field), ("ts_field", ts_field)):
+            if fname is not None and fname not in (self.dtype.names or ()):
+                raise ValueError(f"{name}: {role}='{fname}' is not a field of "
+                                 f"{self.dtype} (fields: {self.dtype.names})")
         self.key_field = key_field
         self.ts_field = ts_field
         self.num_keys = num_keys
@@ -198,15 +207,6 @@ class RecordSource(SourceBase):
             spec[f] = jax.ShapeDtypeStruct(shape, jnp.dtype(base))
         return spec
 
-    def _key_slots(self, col: np.ndarray) -> np.ndarray:
-        if self.num_keys is not None:
-            return np.asarray(hash_key_to_slot(col, self.num_keys))
-        if col.dtype.kind not in "iu":
-            raise TypeError(
-                f"{self.name}: non-integer key field '{self.key_field}' "
-                f"(dtype {col.dtype}) requires num_keys=N for hashing")
-        return col.astype(np.int32)
-
     def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
         from ..native import unpack_records
         next_id = 0
@@ -214,7 +214,7 @@ class RecordSource(SourceBase):
             rec = np.asarray(rec, self.dtype)
             n = rec.shape[0]
             cols = unpack_records(rec)
-            key = (self._key_slots(cols[self.key_field])
+            key = (self._ingest_key(cols[self.key_field])
                    if self.key_field else None)
             ts = cols[self.ts_field] if self.ts_field else None
             payload = {f: cols[f] for f in self.payload_fields}

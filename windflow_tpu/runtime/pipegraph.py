@@ -75,6 +75,11 @@ class MultiPipe:
 
     def add(self, op: Basic_Operator) -> "MultiPipe":
         self._check_open()
+        if isinstance(op, Sink):
+            raise TypeError(
+                f"add({op.name}): host Sinks terminate a MultiPipe — use "
+                f"add_sink()/chain_sink() (in-graph reductions stay addable via "
+                f"ReduceSink)")
         op._mark_used()
         self.graph._register(op)
         self.ops.append(op)
