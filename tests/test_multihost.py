@@ -1,0 +1,48 @@
+"""Multi-host helpers (parallel/multihost.py) — single-process degradation on the
+8-device CPU mesh: the same program text must run with the DCN axis collapsed to 1."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from windflow_tpu.parallel import multihost
+from windflow_tpu.parallel.collective import keyed_all_to_all
+
+
+def test_initialize_is_noop_single_process():
+    assert multihost.initialize() is False
+    assert jax.process_count() == 1
+
+
+def test_dcn_ici_mesh_single_process_shapes():
+    mesh = multihost.make_dcn_ici_mesh(dcn_axis="dp", ici_axes=("key",))
+    assert mesh.axis_names == ("dp", "key")
+    assert mesh.shape["dp"] == 1 and mesh.shape["key"] == 8
+
+    mesh2 = multihost.make_dcn_ici_mesh(dcn_axis="dp", ici_axes=("key", "win"))
+    assert mesh2.axis_names == ("dp", "key", "win")
+    assert mesh2.shape["dp"] == 1
+    assert mesh2.shape["key"] * mesh2.shape["win"] == 8
+
+
+def test_collective_over_ici_axis_of_hybrid_mesh():
+    # keyed all_to_all over the ICI axis of the 2-level mesh (dp collapsed to 1)
+    mesh = multihost.make_dcn_ici_mesh(dcn_axis="dp", ici_axes=("key",))
+    C = 64 * 8
+    keys = jnp.arange(C, dtype=jnp.int32) % 23
+    valid = jnp.ones(C, bool)
+    pay = {"v": jnp.arange(C, dtype=jnp.float32)}
+    sh = NamedSharding(mesh, P("key"))
+    args = jax.tree.map(lambda a: jax.device_put(a, sh), (keys, valid, pay))
+    rk, rv, rp = jax.jit(keyed_all_to_all(mesh, axis="key"))(*args)
+    rk, rv = np.asarray(rk), np.asarray(rv).ravel()
+    per_dev = rk.shape[0] // 8
+    for d in range(8):
+        live = rk[d * per_dev:(d + 1) * per_dev][rv[d * per_dev:(d + 1) * per_dev]]
+        assert np.all(live % 8 == d)
+
+
+def test_process_local_batch_range_single_process():
+    lo, hi = multihost.process_local_batch_range(1000, 128)
+    assert (lo, hi) == (0, 1000)

@@ -4,11 +4,12 @@ from .emitters import (Basic_Emitter, Standard_Emitter, Broadcast_Emitter,
                        Splitting_Emitter, Tree_Emitter)
 from .ordering import Ordering_Node
 from .collective import wmr_map_reduce, ring_pane_windows, keyed_all_to_all
+from . import multihost
 
 __all__ = [
     "make_mesh", "make_mesh_2d", "leading_axis_sharding", "replicated",
     "ShardedChain", "shard_batch", "batch_sharding",
     "Basic_Emitter", "Standard_Emitter", "Broadcast_Emitter",
     "Splitting_Emitter", "Tree_Emitter", "Ordering_Node",
-    "wmr_map_reduce", "ring_pane_windows", "keyed_all_to_all",
+    "wmr_map_reduce", "ring_pane_windows", "keyed_all_to_all", "multihost",
 ]

@@ -1,0 +1,107 @@
+"""Multi-host scale-out: distributed initialization + DCN×ICI mesh construction.
+
+The reference's process boundary is one shared-memory process (SURVEY §1: no
+MPI/NCCL/sockets anywhere in ``wf/``); its scale ceiling is one machine. The
+TPU-native generalization runs one process per host over ``jax.distributed`` with a
+two-level mesh: the OUTER axis spans hosts over DCN (slow, collective-light), the
+INNER axes span each host's chips over ICI (fast, collective-heavy). The framework's
+axis taxonomy (``parallel/mesh.py``) maps on as:
+
+- ``dp`` (batch capacity / operator replication) → DCN-safe: each host's source
+  ingests its own stream partition; no cross-host traffic except at keyed shuffles.
+- ``key`` (Key_Farm state tables) → ICI by default; spanning DCN is correct but the
+  ``keyed_all_to_all`` exchange then rides DCN — size lane budgets accordingly.
+- ``win`` / ``part`` (window/partition axes, `ring_pane_windows`/`wmr_map_reduce`)
+  → keep INSIDE a host (ICI): their per-step halo/all-reduce latency is the window
+  emission latency.
+
+Usage (one process per host, e.g. under a pod scheduler)::
+
+    from windflow_tpu.parallel import multihost
+    multihost.initialize()                      # no-op single-process
+    mesh = multihost.make_dcn_ici_mesh(dcn_axis="dp", ici_axes=("key",))
+    # -> Mesh over all hosts x all local chips; shard states/batches as usual
+
+Single-process fallback: every helper degrades to the local-devices mesh so the same
+program text runs from a laptop test to a pod (tested on the virtual CPU mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from .mesh import make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Initialize ``jax.distributed`` when running multi-process; no-op (returns
+    False) when single-process or already initialized. Arguments default to the
+    standard env-based auto-detection (JAX_COORDINATOR_ADDRESS etc.)."""
+    if jax.process_count() > 1:
+        return False                              # already initialized
+    if coordinator_address is None and num_processes is None:
+        import os
+        if "JAX_COORDINATOR_ADDRESS" not in os.environ:
+            return False                          # single-process run
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return True
+    except RuntimeError:                          # already initialized
+        return False
+
+
+def make_dcn_ici_mesh(dcn_axis: str = "dp",
+                      ici_axes: Sequence[str] = ("key",),
+                      ici_shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Two-level mesh: ``dcn_axis`` spans processes (hosts), ``ici_axes`` span each
+    process's local chips. Uses ``mesh_utils.create_hybrid_device_mesh`` when
+    multi-process (respects DCN/ICI topology); degrades to a flat local mesh with
+    the same axis names single-process, so programs are textually identical."""
+    n_proc = jax.process_count()
+    local = jax.local_device_count()
+    if ici_shape is None:
+        ici_shape = _factor(local, len(ici_axes))
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+        devs = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=ici_shape, dcn_mesh_shape=(n_proc,) + (1,) * (len(ici_shape) - 1))
+        # hybrid mesh returns [dcn*ici0, ici1, ...]; reshape to (dcn, *ici)
+        devs = devs.reshape((n_proc,) + tuple(ici_shape))
+        return Mesh(devs, (dcn_axis,) + tuple(ici_axes))
+    devs = np.array(jax.devices()).reshape((1,) + tuple(ici_shape))
+    return Mesh(devs, (dcn_axis,) + tuple(ici_axes))
+
+
+def _factor(n: int, k: int) -> Tuple[int, ...]:
+    """Split n into k near-balanced power-of-two-ish factors (largest first)."""
+    if k == 1:
+        return (n,)
+    f = 1
+    target = round(n ** (1 / k))
+    for c in range(target, 0, -1):
+        if n % c == 0:
+            f = c
+            break
+    return (n // f,) + _factor(f, k - 1) if k == 2 else (f,) + _factor(n // f, k - 1)
+
+
+def process_local_batch_range(total: int, batch_size: int) -> Tuple[int, int]:
+    """Partition a global stream of ``total`` tuples across processes: each host's
+    source generates/ingests only its contiguous share (the multi-host Source
+    replication rule — reference Source replicas split the stream the same way
+    in-process, ``wf/source.hpp:284-296``)."""
+    p, i = jax.process_count(), jax.process_index()
+    per = -(-total // p)
+    lo = min(i * per, total)
+    hi = min(lo + per, total)
+    # round the share to whole batches so every host steps in lockstep
+    lo -= lo % batch_size
+    return lo, hi
