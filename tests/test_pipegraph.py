@@ -207,3 +207,20 @@ def test_merge_three_pipes():
     merged.add(wf.ReduceSink(lambda t: t.v, name="all"))
     res = g.run()
     assert int(res["all"]) == sum(range(90)) + sum(range(700, 710))
+
+
+def test_closing_function_runs_per_replica_at_teardown():
+    """withClosingFunction (reference closing_func at svc_end): runs once per
+    replica with that replica's RuntimeContext, after EOS."""
+    calls = []
+    m = (Map_Builder(lambda t: {"v": t.v * 2})
+         .withName("m").withParallelism(3)
+         .withClosingFunction(lambda ctx: calls.append(
+             (ctx.getReplicaIndex(), ctx.getParallelism()))).build())
+    src = (Source_Builder(lambda i: {"v": i.astype(jnp.int32)})
+           .withName("s").withTotal(64).build())
+    g = PipeGraph("closing", batch_size=32)
+    g.add_source(src).chain(m).add(
+        ReduceSink_Builder(lambda t: t.v).withName("out").build())
+    g.run()
+    assert sorted(calls) == [(0, 3), (1, 3), (2, 3)]

@@ -33,6 +33,22 @@ class Basic_Operator:
         self._parallelism = max(1, int(parallelism))
         self._used = False
         self._stats = [Stats_Record(name, i) for i in range(self._parallelism)]
+        #: host callback run once per replica at teardown with that replica's
+        #: RuntimeContext (reference closing_func at svc_end; withClosingFunction,
+        #: wf/builders.hpp common methods)
+        self.closing_func = None
+
+    def close(self) -> None:
+        """Invoke the closing function (if any) once per replica — the reference
+        runs ``closing_func(RuntimeContext&)`` in every replica's ``svc_end``."""
+        if self.closing_func is None:
+            return
+        from ..context import RuntimeContext
+        own = getattr(self, "context", None)
+        for i in range(self._parallelism):
+            ctx = (own if own is not None and own.getReplicaIndex() == i
+                   else RuntimeContext(self._parallelism, i))
+            self.closing_func(ctx)
 
     # -- Basic_Operator surface (wf/basic_operator.hpp:47-79) -------------------------
 

@@ -65,13 +65,22 @@ class _Builder:
         self._kw.setdefault("_device", device)
         return self
 
+    def withClosingFunction(self, fn: Callable):
+        """Host callback ``fn(RuntimeContext)`` run once per replica at teardown
+        (reference closing_func at svc_end; wf/builders.hpp common methods)."""
+        self._closing = fn
+        return self
+
     def _pop_private(self):
         self._kw.pop("_batch_hint", None)
         self._kw.pop("_device", None)
 
     def build(self):
         self._pop_private()
-        return self._cls(*self._fns, **self._kw)
+        op = self._cls(*self._fns, **self._kw)
+        if getattr(self, "_closing", None) is not None:
+            op.closing_func = self._closing
+        return op
 
     # C++ API parity aliases (wf/builders.hpp:583-643)
     build_ptr = build

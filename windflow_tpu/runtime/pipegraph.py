@@ -322,6 +322,8 @@ class PipeGraph:
             t.join()
         if errors:
             raise errors[0]
+        for op in self._operators:
+            op.close()                # closing_func per replica (svc_end parity)
         self._ended = True
         return self._results()
 
@@ -348,6 +350,8 @@ class PipeGraph:
         for mp in self._all_pipes():
             if mp.sink is not None:
                 mp.sink.consume(None)
+        for op in self._operators:
+            op.close()                # closing_func per replica (svc_end parity)
         self._ended = True
         return self._results()
 

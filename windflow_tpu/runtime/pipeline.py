@@ -126,4 +126,7 @@ class Pipeline:
                 self.sink.consume(out)
         if self.sink is not None:
             self.sink.consume(None)   # empty-optional EOS signal (wf/sink.hpp)
+        for op in [self.source, *self.chain.ops,
+                   *([self.sink] if self.sink is not None else [])]:
+            op.close()                # closing_func per replica (svc_end parity)
         return self.chain.result()
