@@ -1,0 +1,92 @@
+"""Failure detection + recovery (runtime/supervisor.py): injected step failures
+must be recovered from the last aligned checkpoint with exactly-once sink delivery
+(no duplicated, lost, or torn window results)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.runtime.supervisor import SupervisedPipeline, RestartExhausted
+
+TOTAL, K = 400, 4
+
+
+def build(sink_cb, **kw):
+    src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+                    total=TOTAL, num_keys=K)
+    op = wf.Win_Seq(lambda wid, it: it.sum("v"), WindowSpec(10, 10, win_type_t.TB),
+                    num_keys=K)
+    return SupervisedPipeline(src, [op], wf.Sink(sink_cb), batch_size=50, **kw)
+
+
+def collect(results):
+    def cb(view):
+        if view is None:
+            return
+        results.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                           np.asarray(view["payload"]).tolist()))
+    return cb
+
+
+class Flaky:
+    """Wraps chain.push to raise on chosen batch indices, once each."""
+
+    def __init__(self, chain, fail_at):
+        self.inner = chain.push
+        self.count = 0                        # absolute push-call index
+        self.fail_at = sorted(fail_at)
+
+    def __call__(self, batch):
+        self.count += 1
+        if self.fail_at and self.count == self.fail_at[0]:
+            self.fail_at.pop(0)
+            raise RuntimeError(f"injected device fault at push #{self.count}")
+        return self.inner(batch)
+
+
+def test_no_failure_matches_plain_pipeline():
+    plain, sup = [], []
+    wf.Pipeline(wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+                          total=TOTAL, num_keys=K),
+                [wf.Win_Seq(lambda wid, it: it.sum("v"),
+                            WindowSpec(10, 10, win_type_t.TB), num_keys=K)],
+                wf.Sink(collect(plain)), batch_size=50).run()
+    build(collect(sup)).run()
+    assert sorted(sup) == sorted(plain)
+
+
+@pytest.mark.parametrize("fail_at", [[2], [3, 7], [1, 2, 3]])
+def test_recovers_with_exactly_once_delivery(fail_at):
+    oracle = []
+    build(collect(oracle)).run()
+
+    got = []
+    p = build(collect(got), checkpoint_every=3, max_restarts=5)
+    p.chain.push = Flaky(p.chain, fail_at)
+    p.run()
+    assert p.restarts == len(fail_at)
+    assert sorted(got) == sorted(oracle), "results lost or duplicated on recovery"
+
+
+def test_restart_budget_exhausts_on_permanent_failure():
+    got = []
+    p = build(collect(got), checkpoint_every=4, max_restarts=2)
+
+    def always_fail(batch):
+        raise RuntimeError("permanent fault")
+    p.chain.push = always_fail
+    with pytest.raises(RestartExhausted):
+        p.run()
+
+
+def test_spill_checkpoint_written(tmp_path):
+    got = []
+    path = str(tmp_path / "sup_ckpt.npz")
+    p = build(collect(got), checkpoint_every=2, spill_path=path)
+    p.run()
+    import numpy as np
+    data = np.load(path)
+    assert "__meta__" in data

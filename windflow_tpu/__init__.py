@@ -27,6 +27,7 @@ from .operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT, Pane_Farm,
 from .runtime import CompiledChain, Pipeline, Stats_Record
 from .runtime.pipegraph import PipeGraph, MultiPipe
 from .runtime.threaded import ThreadedPipeline
+from .runtime.supervisor import SupervisedPipeline, RestartExhausted
 from .runtime import builders
 from .runtime.builders import (Source_Builder, Filter_Builder, Map_Builder,
                                FlatMap_Builder, Accumulator_Builder,

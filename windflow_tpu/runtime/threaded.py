@@ -120,6 +120,12 @@ class ThreadedPipeline:
             t.join()
         if self._errors:
             raise self._errors[0]
+        for c in self.chains:
+            for op in c.ops:
+                op.close()            # closing_func per replica (svc_end parity)
+        self.source.close()
+        if self.sink is not None:
+            self.sink.close()
         res = {}
         for c in self.chains:
             res.update(c.result())
