@@ -126,3 +126,31 @@ def test_tb_sliding_with_lateness():
         if content:
             expect.append((w, sum(content)))
     assert sorted(results) == sorted(expect)
+
+
+def test_iterable_positional_access():
+    """at/[]/first/last (reference wf/iterable.hpp begin/end/at/operator[])."""
+    import windflow_tpu as wf
+    from windflow_tpu.operators.win_seq import Win_Seq
+
+    results = []
+
+    def win_fn(wid, it):
+        # span = last.v - first.v; mid = it[1].v (second live tuple)
+        return it.last().v - it.first().v + 100.0 * it[1].v
+
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=40, num_keys=1)
+
+    def cb(view):
+        if view is None:
+            return
+        results.extend(zip(view["id"].tolist(),
+                           np.asarray(view["payload"]).tolist()))
+
+    wf.Pipeline(src, [Win_Seq(win_fn, WindowSpec(8, 8, win_type_t.CB),
+                              num_keys=1)], wf.Sink(cb), batch_size=16).run()
+    got = dict(results)
+    for w in range(5):
+        base = w * 8.0
+        want = (base + 7) - base + 100.0 * (base + 1)
+        assert abs(got[w] - want) < 1e-3, (w, got[w], want)

@@ -87,6 +87,30 @@ class Iterable:
     def size(self):
         return jnp.sum(self.mask.astype(jnp.int32))
 
+    def at(self, i):
+        """The i-th LIVE tuple of the window in order (reference ``at``/
+        ``operator[]``, ``wf/iterable.hpp``). Gather-free: one-hot select over the
+        row. Out-of-range i returns zeros (mask-discipline: pair with ``size()``)."""
+        from ..batch import TupleRef
+        pos = jnp.cumsum(self.mask.astype(jnp.int32)) - 1
+        onehot = self.mask & (pos == i)
+
+        def pick(x):
+            oh = onehot.reshape(onehot.shape + (1,) * (x.ndim - 1))
+            return jnp.sum(jnp.where(oh, x, jnp.zeros((), x.dtype)), axis=0)
+        return TupleRef(key=None, id=pick(self.ids), ts=pick(self.ts),
+                        data=jax.tree.map(pick, self.data))
+
+    __getitem__ = at
+
+    def first(self):
+        """First live tuple (reference begin())."""
+        return self.at(0)
+
+    def last(self):
+        """Last live tuple (reference end()-1)."""
+        return self.at(self.size() - 1)
+
     # mask-aware reductions (the common window aggregations)
     def _masked(self, v, fill):
         m = self.mask.reshape(self.mask.shape + (1,) * (v.ndim - 1))
