@@ -441,16 +441,47 @@ class PipeGraph:
         out = chain.push(batch)
         self._deliver(mp, out)
 
+    def _ordering_of(self, merged: MultiPipe):
+        """Per-merge Ordering_Node (DETERMINISTIC mode): holds tuples back to the
+        low-watermark over the merge's input channels — the reference inserts the
+        node before each replica the same way (wf/pipegraph.hpp:1197-1248)."""
+        if merged._ordering is None:
+            from ..parallel.ordering import Ordering_Node
+            merged._ordering = Ordering_Node(len(merged.merge_inputs))
+        return merged._ordering
+
+    def _chunks(self, batch: Optional[Batch]):
+        """Compact a released (variable-capacity) batch and re-slice it into
+        batch_size-capacity pieces so downstream chains keep ONE compiled shape."""
+        import numpy as np
+        if batch is None:
+            return
+        b = batch.compact()
+        n = int(np.asarray(jnp.sum(b.valid)))
+        cap = self.batch_size
+        for s in range(0, n, cap):
+            def cut(a):
+                seg = a[s:s + cap]
+                pad = cap - seg.shape[0]
+                if pad:
+                    seg = jnp.pad(seg, [(0, pad)] + [(0, 0)] * (seg.ndim - 1))
+                return seg
+            yield Batch(key=cut(b.key), id=cut(b.id), ts=cut(b.ts),
+                        payload=jax.tree.map(cut, b.payload), valid=cut(b.valid))
+
     def _deliver(self, mp: MultiPipe, out: Batch):
         if mp.sink is not None:
             mp.sink.consume(out)
         if mp.split_fn is not None:
             self._push_split(mp, out)
         for merged in mp._outputs_to:
-            b = out
             if self.mode == Mode.DETERMINISTIC:
-                b = b.sorted_by(by="ts")
-            self._push(merged, b)
+                rel = self._ordering_of(merged).push(
+                    merged.merge_inputs.index(mp), out)
+                for piece in self._chunks(rel):
+                    self._push(merged, piece)
+            else:
+                self._push(merged, out)
 
     def _push_split(self, mp: MultiPipe, out: Batch):
         n = len(mp.split_branches)
