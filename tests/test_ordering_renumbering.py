@@ -1,0 +1,138 @@
+"""Ordering_Node modes and the DETERMINISTIC broadcast+renumbering case.
+
+Reference: ``wf/ordering_node.hpp:47-287`` (ID/TS/TS_RENUMBERING release,
+renumbering at ``:218,257``) and the count-based-windows-after-shuffle rule at
+``wf/pipegraph.hpp:1954-1957`` — a CB windowed operator downstream of a
+DETERMINISTIC merge must see tuples in deterministic (ts) arrival order with
+progressive ids, or the per-key window contents depend on merge scheduling.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import Mode, ordering_mode_t, win_type_t
+from windflow_tpu.batch import Batch, CTRL_DTYPE
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.parallel.ordering import Ordering_Node
+from windflow_tpu.runtime.pipegraph import PipeGraph
+
+
+def mk_batch(ids, ts=None, vals=None):
+    ids = np.asarray(ids, np.int32)
+    ts = ids if ts is None else np.asarray(ts, np.int32)
+    vals = ids.astype(np.float32) if vals is None else np.asarray(vals, np.float32)
+    return Batch(key=jnp.zeros(len(ids), CTRL_DTYPE), id=jnp.asarray(ids),
+                 ts=jnp.asarray(ts), payload={"v": jnp.asarray(vals)},
+                 valid=jnp.ones(len(ids), bool))
+
+
+def drain(node, pushes):
+    """Push (channel, batch) pairs then flush; return the released id sequence."""
+    out = []
+
+    def take(b):
+        if b is None:
+            return
+        v = np.asarray(b.valid)
+        out.extend(np.asarray(b.id)[v].tolist())
+
+    for ch, b in pushes:
+        take(node.push(ch, b))
+    take(node.flush())
+    return out
+
+
+def test_ordering_node_id_mode_low_watermark():
+    node = Ordering_Node(2, ordering_mode_t.ID)
+    rel = node.push(0, mk_batch([3, 1, 5]))
+    assert rel is None or not bool(np.asarray(rel.valid).any())  # ch1 has no wm yet
+    rel = node.push(1, mk_batch([2, 4]))
+    # low watermark = min(max ids) = min(5, 4) = 4 -> ids <= 4 release, sorted
+    got = np.asarray(rel.id)[np.asarray(rel.valid)].tolist()
+    assert got == [1, 2, 3, 4]
+    final = drain(node, [])
+    assert final == [5]
+
+
+def test_ordering_node_ts_mode_interleave():
+    node = Ordering_Node(2, ordering_mode_t.TS)
+    got = drain(node, [(0, mk_batch([0, 1], ts=[0, 20])),
+                       (1, mk_batch([10, 11], ts=[10, 30])),
+                       (0, mk_batch([2], ts=[40])),
+                       (1, mk_batch([12], ts=[50]))])
+    # ids in ts order: ts 0,10,20,30,40,50 -> ids 0,10,1,11,2,12
+    assert got == [0, 10, 1, 11, 2, 12]
+
+
+def test_ordering_node_ts_renumbering_progressive_ids():
+    node = Ordering_Node(2, ordering_mode_t.TS_RENUMBERING)
+    got = drain(node, [(0, mk_batch([100, 200], ts=[5, 15])),
+                       (1, mk_batch([300, 400], ts=[10, 20]))])
+    # renumbered: progressive ids 0..n-1 in ts order regardless of original ids
+    assert got == [0, 1, 2, 3]
+
+
+def test_ordering_node_channel_eos_unblocks():
+    node = Ordering_Node(2, ordering_mode_t.TS)
+    assert node.push(0, mk_batch([1, 2], ts=[1, 2])) is None  # ch1 silent: held
+    rel = node.close_channel(1)                               # ch1 EOS: stops gating
+    got = np.asarray(rel.id)[np.asarray(rel.valid)].tolist()
+    assert got == [1, 2]
+
+
+K = 2
+
+
+def run_cb(batch_size, swap=False, threaded=False):
+    """CB windows downstream of a DETERMINISTIC merge (renumbering case)."""
+    g = PipeGraph("det_cb", batch_size=batch_size, mode=Mode.DETERMINISTIC)
+    sa = wf.Source(lambda i: {"v": (i % 5).astype(jnp.float32)}, total=100,
+                   num_keys=K, ts_fn=lambda i: 2 * i, name="even_ts")
+    sb = wf.Source(lambda i: {"v": (i % 7).astype(jnp.float32)}, total=100,
+                   num_keys=K, ts_fn=lambda i: 2 * i + 1, name="odd_ts")
+    pa, pb = g.add_source(sa), g.add_source(sb)
+    m = pb.merge(pa) if swap else pa.merge(pb)
+    out = []
+
+    def cb(view):
+        if view is None:
+            return
+        out.extend((int(k), int(w), round(float(r), 4)) for k, w, r in
+                   zip(view["key"].tolist(), view["id"].tolist(),
+                       np.asarray(view["payload"]).tolist()))
+
+    m.add(wf.Win_Seq(lambda wid, it: it.sum("v"),
+                     WindowSpec(10, 10, win_type_t.CB),
+                     num_keys=K)).add_sink(wf.Sink(cb))
+    g.run(threaded=threaded)
+    return sorted(out)
+
+
+def cb_oracle():
+    """Per-key ts-ordered arrival stream chunked into CB windows of 10."""
+    per_key = {k: [] for k in range(K)}
+    rows = []
+    for i in range(100):
+        rows.append((2 * i, i % K, i % 5))
+        rows.append((2 * i + 1, i % K, i % 7))
+    for ts, k, v in sorted(rows):
+        per_key[k].append(v)
+    want = []
+    for k, vs in per_key.items():
+        for w in range(0, -(-len(vs) // 10)):
+            want.append((k, w, round(float(sum(vs[10 * w:10 * w + 10])), 4)))
+    return sorted(want)
+
+
+@pytest.mark.parametrize("batch_size", [32, 77, 200])
+def test_deterministic_cb_windows_after_merge(batch_size):
+    assert run_cb(batch_size) == cb_oracle()
+
+
+def test_deterministic_cb_invariant_operand_order_and_driver():
+    base = run_cb(50)
+    assert run_cb(50, swap=True) == base
+    assert run_cb(50, threaded=True) == base
+    assert run_cb(80, swap=True, threaded=True) == base

@@ -68,12 +68,24 @@ class Ordering_Node:
                 self._wm[channel], mx)
         self._pending = (batch if self._pending is None
                          else concat_batches(self._pending, batch))
-        if any(w is None for w in self._wm):
+        return self.try_release()
+
+    def try_release(self) -> Optional[Batch]:
+        """Release the prefix at or below the current low-watermark, if every
+        channel has established one."""
+        if self._pending is None or any(w is None for w in self._wm):
             return None
         low = min(self._wm)
         out, kept = self._release_jit(self._pending, jnp.asarray(low, CTRL_DTYPE))
         self._pending = kept
         return self._maybe_renumber(out)
+
+    def close_channel(self, channel: int) -> Optional[Batch]:
+        """Channel EOS: it no longer gates the low-watermark (the reference drops
+        the channel from ``maxs[]`` when its EOS marker arrives). Returns any batch
+        that the advanced watermark releases."""
+        self._wm[channel] = int(jnp.iinfo(CTRL_DTYPE).max - 1)
+        return self.try_release()
 
     def flush(self) -> Optional[Batch]:
         """EOS: release everything, sorted."""

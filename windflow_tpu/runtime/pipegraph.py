@@ -70,6 +70,7 @@ class MultiPipe:
         self._dataflow_parent: Optional[MultiPipe] = None   # split-branch feeder
         self._chain: Optional[CompiledChain] = None
         self._outputs_to: List[MultiPipe] = []
+        self._ordering = None     # lazily-built Ordering_Node (DETERMINISTIC merges)
 
     # -- construction (reference add/chain overloads, wf/pipegraph.hpp:1565-2950) -----
 
@@ -237,6 +238,7 @@ class PipeGraph:
         # consumer with several inputs (merge) polls its rings round-robin
         in_queues = {id(p): [] for p in pipes}
         out_edges = {}                           # (producer id, consumer id) -> queue
+        channel_of = {}                          # queue id -> merge channel index
 
         def add_edge(src_id, dst):
             q = SPSCQueue(8)
@@ -250,7 +252,8 @@ class PipeGraph:
             for b in p.split_branches:
                 add_edge(id(p), b)
             for m in p._outputs_to:
-                add_edge(id(p), m)
+                q = add_edge(id(p), m)
+                channel_of[id(q)] = m.merge_inputs.index(p)
         errors = []
 
         def deliver(mp, out):
@@ -265,10 +268,7 @@ class PipeGraph:
                         keep = jnp.asarray(sel, jnp.int32) == i
                     out_edges[(id(mp), id(branch))].push(out.mask(keep))
             for merged in mp._outputs_to:
-                b = out
-                if self.mode == Mode.DETERMINISTIC:
-                    b = b.sorted_by(by="ts")
-                out_edges[(id(mp), id(merged))].push(b)
+                out_edges[(id(mp), id(merged))].push(out)
 
         def propagate_eos(mp):
             for branch in mp.split_branches:
@@ -277,6 +277,16 @@ class PipeGraph:
                 out_edges[(id(mp), id(merged))].push(EOS)
 
         def pipe_body(mp):
+            # DETERMINISTIC merges go through the SAME Ordering_Node as the push
+            # driver — cross-channel low-watermark holdback, not per-batch sorting
+            onode = (self._ordering_of(mp)
+                     if self.mode == Mode.DETERMINISTIC and mp.merge_inputs
+                     else None)
+
+            def run_batch(item):
+                chain = mp._compile(item.capacity)
+                deliver(mp, chain.push(item))
+
             try:
                 live = list(in_queues[id(mp)])
                 while live:
@@ -286,9 +296,20 @@ class PipeGraph:
                             continue
                         if item is EOS:
                             live.remove(q)
+                            if onode is not None and id(q) in channel_of:
+                                rel = onode.close_channel(channel_of[id(q)])
+                                for piece in self._chunks(rel):
+                                    run_batch(piece)
                             continue
-                        chain = mp._compile(item.capacity)
-                        deliver(mp, chain.push(item))
+                        if onode is not None and id(q) in channel_of:
+                            rel = onode.push(channel_of[id(q)], item)
+                            for piece in self._chunks(rel):
+                                run_batch(piece)
+                        else:
+                            run_batch(item)
+                if onode is not None:
+                    for piece in self._chunks(onode.flush()):
+                        run_batch(piece)
                 if mp._chain is not None:
                     for out in mp._chain.flush():
                         deliver(mp, out)
@@ -344,8 +365,12 @@ class PipeGraph:
                 continue
             self._push(mp, batch)
             round_robin_pos += 1
-        # EOS: flush every pipe in topological order
+        # EOS: flush every pipe in topological order; a merged pipe first drains
+        # its Ordering_Node (tuples held back by the low-watermark)
         for mp in self._topo_order():
+            if mp._ordering is not None:
+                for piece in self._chunks(mp._ordering.flush()):
+                    self._push(mp, piece)
             self._flush_pipe(mp)
         for mp in self._all_pipes():
             if mp.sink is not None:
@@ -444,10 +469,19 @@ class PipeGraph:
     def _ordering_of(self, merged: MultiPipe):
         """Per-merge Ordering_Node (DETERMINISTIC mode): holds tuples back to the
         low-watermark over the merge's input channels — the reference inserts the
-        node before each replica the same way (wf/pipegraph.hpp:1197-1248)."""
+        node before each replica the same way (wf/pipegraph.hpp:1197-1248).
+        Count-based windows downstream of the merge get TS_RENUMBERING (the
+        reference's broadcast+renumbering case, wf/pipegraph.hpp:1954-1957,
+        wf/ordering_node.hpp:218,257) so released tuples carry progressive ids."""
         if merged._ordering is None:
+            from ..basic import ordering_mode_t
             from ..parallel.ordering import Ordering_Node
-            merged._ordering = Ordering_Node(len(merged.merge_inputs))
+            cb_downstream = any(
+                getattr(getattr(op, "spec", None), "is_cb", False)
+                for op in merged.ops)
+            mode = (ordering_mode_t.TS_RENUMBERING if cb_downstream
+                    else ordering_mode_t.TS)
+            merged._ordering = Ordering_Node(len(merged.merge_inputs), mode)
         return merged._ordering
 
     def _chunks(self, batch: Optional[Batch]):
