@@ -1,0 +1,85 @@
+"""Regression tests for honest accounting and safety guards (round-2 verdict #9):
+num_kernels counts compiled launches (not operators), Win_Seq rejects an unbounded
+default fired-window budget, KeyedMap's single-round fast path rejects same-key
+duplicates instead of silently dropping updates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.operators.map import KeyedMap
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.operators.win_seq import Win_Seq
+
+
+def test_num_kernels_counts_launches_not_operators():
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=128, num_keys=2)
+    ops = [wf.Map(lambda t: {"v": t.v + 1}),
+           wf.Filter(lambda t: t.v >= 0),
+           wf.Map(lambda t: {"v": t.v * 2})]
+    p = wf.Pipeline(src, ops, wf.Sink(lambda v: None), batch_size=32)
+    p.run()
+    total_kernels = sum(op.get_StatsRecords()[0].num_kernels for op in ops)
+    pushes = ops[0].get_StatsRecords()[0].batches_received
+    assert pushes == 4                      # 128 tuples / batch 32
+    # the 3-op chain is ONE fused program: one kernel per push, not one per op
+    assert total_kernels == pushes
+
+
+def test_win_seq_default_budget_guard():
+    op = Win_Seq(lambda wid, it: it.sum("v"), WindowSpec(1024, 1, win_type_t.CB),
+                 num_keys=4)
+    with pytest.raises(ValueError, match="max_wins"):
+        op.out_capacity(65536)              # slide=1 @ 64k batch: [64k+, 1024] gather
+
+
+def test_win_seq_default_budget_ok_with_explicit_max_wins():
+    op = Win_Seq(lambda wid, it: it.sum("v"), WindowSpec(1024, 1, win_type_t.CB),
+                 num_keys=4, max_wins=128)
+    assert op.out_capacity(65536) == 128
+
+
+def _dup_batch():
+    from windflow_tpu.batch import Batch
+    return Batch(key=jnp.asarray([1, 1, 2], jnp.int32),     # duplicate key 1
+                 id=jnp.arange(3, dtype=jnp.int32), ts=jnp.arange(3, dtype=jnp.int32),
+                 payload={"v": jnp.asarray([1.0, 2.0, 3.0], jnp.float32)},
+                 valid=jnp.ones(3, bool))
+
+
+def test_keyed_map_folds_duplicates_in_order_even_unordered():
+    # ordered=False no longer drops updates: duplicates take the in-order fallback
+    op = KeyedMap(lambda t, s: ({"v": s + t.v}, s + t.v), jnp.float32(0),
+                  num_keys=4, ordered=False)
+    st = op.init_state({"v": jax.ShapeDtypeStruct((), jnp.float32)})
+    st, out = jax.jit(op.apply)(st, _dup_batch())
+    # key 1: running sums 1, then 1+2=3; key 2: 3
+    np.testing.assert_allclose(np.asarray(out.payload["v"]), [1.0, 3.0, 3.0])
+    np.testing.assert_allclose(float(st[1]), 3.0)
+
+
+def test_keyed_map_static_promise_violation_fails_loudly():
+    op = KeyedMap(lambda t, s: ({"v": s + t.v}, s + t.v), jnp.float32(0),
+                  num_keys=4, max_key_multiplicity=1)
+    st = op.init_state({"v": jax.ShapeDtypeStruct((), jnp.float32)})
+    with pytest.raises(Exception,
+                       match="max_key_multiplicity|callback|CpuCallback"):
+        _, out = jax.jit(op.apply)(st, _dup_batch())
+        jax.block_until_ready(out.payload["v"])
+        jax.effects_barrier()
+
+
+def test_keyed_map_fast_path_ok_without_duplicates():
+    op = KeyedMap(lambda t, s: ({"v": s + t.v}, s + t.v), jnp.float32(0),
+                  num_keys=4, ordered=False)
+    st = op.init_state({"v": jax.ShapeDtypeStruct((), jnp.float32)})
+    from windflow_tpu.batch import Batch
+    b = Batch(key=jnp.asarray([0, 1, 2], jnp.int32),
+              id=jnp.arange(3, dtype=jnp.int32), ts=jnp.arange(3, dtype=jnp.int32),
+              payload={"v": jnp.ones(3, jnp.float32)},
+              valid=jnp.ones(3, bool))
+    _, out = jax.jit(op.apply)(st, b)
+    np.testing.assert_allclose(np.asarray(out.payload["v"]), [1.0, 1.0, 1.0])

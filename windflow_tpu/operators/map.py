@@ -77,10 +77,17 @@ class KeyedMap(Basic_Operator):
     """Stateful map with a per-key HBM state table.
 
     ``f(t, state_k) -> (payload, new_state_k)``; ``init_state_value`` is the per-key
-    initial state pytree. The fast path assumes at most one live tuple per key per
-    batch *or* an associative-style independence; the exact sequential-within-key
-    semantics are provided by ``ordered=True`` which folds same-key tuples in stream
-    order with ``lax.scan`` over the max per-key multiplicity."""
+    initial state pytree. Same-key tuples within a batch are always folded in stream
+    order: each batch dynamically takes a single-round fast path when every live key
+    is unique, else a multi-round in-order fold (``lax.cond`` between the two) — the
+    per-key serialization the reference documents as its stateful floor
+    (results.org:8,37), paid only within a batch and only when duplicates occur.
+
+    ``max_key_multiplicity=1`` is a *static* promise that batches never hold same-key
+    duplicates: the fallback branch is not even compiled, and a violated promise
+    fails loudly (asynchronously, at the next sync point) instead of dropping state
+    updates. ``ordered`` is kept for API compatibility and no longer weakens
+    semantics."""
 
     routing = routing_modes_t.KEYBY
 
@@ -110,43 +117,56 @@ class KeyedMap(Basic_Operator):
         from ..ops.segment import segment_rank
         refs = tuple_refs(batch)
         rank = segment_rank(batch.key, batch.valid)
-        # Fold same-key tuples in stream order: round r processes the lanes whose
-        # per-key rank is r (gather state row, apply fn, scatter updated row). Rounds
-        # run up to the *observed* max multiplicity in this batch — for well-spread
-        # keys that is 1-2 rounds; callers that guarantee one tuple per key per batch
-        # can set max_key_multiplicity=1 to make it a single static round. This is the
-        # per-key serialization the reference documents as its stateful floor
-        # (1 key => 0.44-0.64 M t/s, results.org:8,37) — but paid only *within* a
-        # batch, not across the whole stream.
-        if self.max_key_multiplicity == 1 or not self.ordered:
-            st_k = jax.tree.map(lambda tbl: table_lookup(tbl, batch.key), state)
-            res, new_st = jax.vmap(self.fn)(refs, st_k)
-            safe_key = jnp.where(batch.valid, batch.key, self.num_keys)
-            state = jax.tree.map(
-                lambda tbl, ns: tbl.at[safe_key].set(ns, mode="drop"), state, new_st)
-            return state, batch.with_payload(res)
-
         max_rank = jnp.max(jnp.where(batch.valid, rank, 0))
 
-        def round_body(r, carry):
-            st, out_payload = carry
-            active = batch.valid & (rank == r)
+        def fast(st):
+            # one gather-apply-scatter round — correct iff every live key is unique
             st_k = jax.tree.map(lambda tbl: table_lookup(tbl, batch.key), st)
             res, new_st = jax.vmap(self.fn)(refs, st_k)
-            safe_key = jnp.where(active, batch.key, self.num_keys)
+            safe_key = jnp.where(batch.valid, batch.key, self.num_keys)
             st = jax.tree.map(
                 lambda tbl, ns: tbl.at[safe_key].set(ns, mode="drop"), st, new_st)
-            out_payload = jax.tree.map(
-                lambda o, nv: jnp.where(
-                    active.reshape(active.shape + (1,) * (nv.ndim - 1)), nv, o),
-                out_payload, res)
-            return st, out_payload
+            return st, res
 
-        out_shape = jax.eval_shape(
-            lambda s, b: jax.vmap(self.fn)(
-                tuple_refs(b), jax.tree.map(lambda t: table_lookup(t, b.key), s))[0],
-            state, batch)
-        out0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
-        state, out_payload = jax.lax.fori_loop(
-            0, max_rank + 1, round_body, (state, out0))
-        return state, batch.with_payload(out_payload)
+        def multi(st):
+            # round r processes the lanes whose per-key rank is r — in-order fold
+            # of same-key duplicates, up to the observed max multiplicity
+            def round_body(r, carry):
+                st, out_payload = carry
+                active = batch.valid & (rank == r)
+                st_k = jax.tree.map(lambda tbl: table_lookup(tbl, batch.key), st)
+                res, new_st = jax.vmap(self.fn)(refs, st_k)
+                safe_key = jnp.where(active, batch.key, self.num_keys)
+                st = jax.tree.map(
+                    lambda tbl, ns: tbl.at[safe_key].set(ns, mode="drop"), st, new_st)
+                out_payload = jax.tree.map(
+                    lambda o, nv: jnp.where(
+                        active.reshape(active.shape + (1,) * (nv.ndim - 1)), nv, o),
+                    out_payload, res)
+                return st, out_payload
+
+            out_shape = jax.eval_shape(
+                lambda s, b: jax.vmap(self.fn)(
+                    tuple_refs(b),
+                    jax.tree.map(lambda t: table_lookup(t, b.key), s))[0],
+                st, batch)
+            out0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
+            return jax.lax.fori_loop(0, max_rank + 1, round_body, (st, out0))
+
+        if self.max_key_multiplicity == 1:
+            # static promise: no fallback branch compiled; a violated promise fails
+            # loudly (async, at the next sync point) instead of dropping updates
+            jax.debug.callback(_reject_duplicate_keys, max_rank, self.name)
+            state, res = fast(state)
+        else:
+            state, res = jax.lax.cond(max_rank == 0, fast, multi, state)
+        return state, batch.with_payload(res)
+
+
+def _reject_duplicate_keys(max_rank, name):
+    if int(max_rank) > 0:
+        raise ValueError(
+            f"KeyedMap '{name}': a batch holds {int(max_rank) + 1} tuples of one "
+            f"key, violating the max_key_multiplicity=1 promise (the single-round "
+            f"path would drop state updates); remove max_key_multiplicity=1 to get "
+            f"the dynamic in-order fallback")

@@ -163,7 +163,19 @@ class Win_Seq(Basic_Operator):
     def _resolve_w(self, capacity: int) -> int:
         if self.max_wins is not None:
             return self.max_wins
-        return max(16, -(-capacity // self.spec.slide) + 64)
+        W = max(16, -(-capacity // self.spec.slide) + 64)
+        L = self.spec.win_len if self.spec.is_cb else self.A
+        if W * L > (1 << 22):
+            # adversarial slide (e.g. slide=1 at large batch) would imply a [W, L]
+            # gather per batch per payload leaf — force an explicit budget instead
+            # of silently allocating it (the reference sizes this with batch_len,
+            # wf/win_seq_gpu.hpp tuples_per_batch)
+            raise ValueError(
+                f"{self.name}: default fired-window budget W={W} with window row "
+                f"length L={L} implies a [{W}, {L}] gather per batch "
+                f"({W * L} elements per payload leaf); pass max_wins= to bound the "
+                f"per-batch fired-window budget")
+        return W
 
     def _fired_range(self, state: WinSeqState, flush: bool):
         s = self.spec

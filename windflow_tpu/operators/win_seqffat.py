@@ -13,9 +13,10 @@ segment-reduced into its (key, pane) partial; a fired window combines its
 the same work-sharing as FlatFAT (each tuple touches O(1) partials; each window
 combines O(L/pane) —  with panes = slide that is the "no pane, no gain" decomposition
 the reference's Pane_Farm uses, ``wf/pane_farm.hpp:175``), expressed as segment ops the
-MXU/VPU likes instead of pointer-chasing tree levels. An exact prefix/suffix FlatFAT
-(for non-commutative combines needing strict in-order association) is provided by
-``ops/flatfat.py`` via ``associative_scan``.
+MXU/VPU likes instead of pointer-chasing tree levels. Non-commutative combines are
+supported: pane partials are folded in ascending pane order by an order-preserving
+tree reduction (association changes, operand order does not — the same guarantee as
+FlatFAT's prefix/suffix walks; see ``tests/test_ffat_noncommutative.py``).
 
 Requirements: ``combine`` associative with ``identity``; window result =
 ``fold(combine, lifted tuples in window)`` — the Win_SeqFFAT contract (winLift +

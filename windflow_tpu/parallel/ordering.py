@@ -34,25 +34,35 @@ class Ordering_Node:
         self.mode = mode
         self._wm = [None] * self.n_inputs        # per-channel high watermark
         self._pending: Optional[Batch] = None
+        self._pending_chan = None                # i32[C] source channel per lane
         self._next_id = 0
         self._release_jit = jax.jit(self._release)
 
     # -- jitted core ------------------------------------------------------------------
 
-    def _sort_key(self, b: Batch):
-        return b.id if self.mode == ordering_mode_t.ID else b.ts
+    def _sort_keys(self, b: Batch, chan):
+        """(primary, secondary, tertiary) composite sort: id/ts, then the other
+        control field, then source channel — a TOTAL deterministic order even when
+        two channels carry equal (ts, id) pairs (poll interleaving must not leak
+        into release order)."""
+        prim = b.id if self.mode == ordering_mode_t.ID else b.ts
+        sec = b.ts if self.mode == ordering_mode_t.ID else b.id
+        return prim, sec, chan
 
-    def _release(self, pending: Batch, low_wm):
-        k = self._sort_key(pending)
+    def _release(self, pending: Batch, chan, low_wm):
         big = jnp.iinfo(CTRL_DTYPE).max
-        keyv = jnp.where(pending.valid, k, big)
-        order = jnp.argsort(keyv, stable=True)
+        prim, sec, tert = self._sort_keys(pending, chan)
+        primv = jnp.where(pending.valid, prim, big)
+        # jnp.lexsort: LAST key is the primary sort key
+        order = jnp.lexsort((tert, sec, primv))
         sortedb = pending.select(order, jnp.ones_like(pending.valid))
-        ks = jnp.where(sortedb.valid, self._sort_key(sortedb), big)
+        chan_s = jnp.take(chan, order)
+        ks = jnp.where(sortedb.valid,
+                       self._sort_keys(sortedb, chan_s)[0], big)
         releasable = ks <= low_wm
         out = sortedb.mask(releasable)
         kept = sortedb.mask(sortedb.valid & ~releasable)
-        return out, kept
+        return out, kept, chan_s
 
     # -- host protocol ----------------------------------------------------------------
 
@@ -60,14 +70,18 @@ class Ordering_Node:
         """Deliver a batch from ``channel``; returns a released (ordered) batch or
         None if nothing can be released yet."""
         import numpy as np
-        k = np.asarray(self._sort_key(batch))
+        k = np.asarray(batch.id if self.mode == ordering_mode_t.ID else batch.ts)
         v = np.asarray(batch.valid)
         if v.any():
             mx = int(k[v].max())
             self._wm[channel] = mx if self._wm[channel] is None else max(
                 self._wm[channel], mx)
-        self._pending = (batch if self._pending is None
-                         else concat_batches(self._pending, batch))
+        chan = jnp.full((batch.capacity,), channel, CTRL_DTYPE)
+        if self._pending is None:
+            self._pending, self._pending_chan = batch, chan
+        else:
+            self._pending = concat_batches(self._pending, batch)
+            self._pending_chan = jnp.concatenate([self._pending_chan, chan])
         return self.try_release()
 
     def try_release(self) -> Optional[Batch]:
@@ -76,8 +90,9 @@ class Ordering_Node:
         if self._pending is None or any(w is None for w in self._wm):
             return None
         low = min(self._wm)
-        out, kept = self._release_jit(self._pending, jnp.asarray(low, CTRL_DTYPE))
-        self._pending = kept
+        out, kept, kept_chan = self._release_jit(
+            self._pending, self._pending_chan, jnp.asarray(low, CTRL_DTYPE))
+        self._pending, self._pending_chan = kept, kept_chan
         return self._maybe_renumber(out)
 
     def close_channel(self, channel: int) -> Optional[Batch]:
@@ -91,9 +106,10 @@ class Ordering_Node:
         """EOS: release everything, sorted."""
         if self._pending is None:
             return None
-        out, _ = self._release_jit(self._pending,
-                                   jnp.asarray(jnp.iinfo(CTRL_DTYPE).max - 1, CTRL_DTYPE))
-        self._pending = None
+        out, _, _ = self._release_jit(
+            self._pending, self._pending_chan,
+            jnp.asarray(jnp.iinfo(CTRL_DTYPE).max - 1, CTRL_DTYPE))
+        self._pending, self._pending_chan = None, None
         return self._maybe_renumber(out)
 
     def _maybe_renumber(self, out: Optional[Batch]) -> Optional[Batch]:

@@ -199,6 +199,7 @@ class PipeGraph:
         self._operators: List[Basic_Operator] = []
         self._started = False
         self._ended = False
+        self._exhausted = set()       # pipe ids whose inputs are known complete
 
     # -- reference surface ------------------------------------------------------------
 
@@ -362,6 +363,7 @@ class PipeGraph:
                 batch = next(it)
             except StopIteration:
                 live.remove((mp, it))
+                self._exhaust(mp)
                 continue
             self._push(mp, batch)
             round_robin_pos += 1
@@ -527,6 +529,27 @@ class PipeGraph:
             else:
                 keep = jnp.asarray(sel, jnp.int32) == i
             self._push(branch, out.mask(keep))
+
+    def _exhaust(self, mp: MultiPipe):
+        """A pipe's inputs are complete: flush its chain now, close its channels
+        into DETERMINISTIC merge Ordering_Nodes (a frozen watermark must not gate —
+        or hoard — the surviving channels, cf. close_channel), and cascade to
+        consumers whose every input is now exhausted. Keeps Ordering_Node memory
+        bounded when merge inputs are unbalanced."""
+        if id(mp) in self._exhausted:
+            return
+        self._exhausted.add(id(mp))
+        self._flush_pipe(mp)
+        for branch in mp.split_branches:
+            self._exhaust(branch)
+        for merged in mp._outputs_to:
+            if self.mode == Mode.DETERMINISTIC:
+                rel = self._ordering_of(merged).close_channel(
+                    merged.merge_inputs.index(mp))
+                for piece in self._chunks(rel):
+                    self._push(merged, piece)
+            if all(id(p) in self._exhausted for p in merged.merge_inputs):
+                self._exhaust(merged)
 
     def _flush_pipe(self, mp: MultiPipe):
         if mp._chain is None:

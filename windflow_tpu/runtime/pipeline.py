@@ -68,13 +68,15 @@ class CompiledChain:
         """Run one batch through ops[from_op:]; updates states; returns the out batch."""
         states, out = self._step_fn(from_op)(tuple(self.states), batch)
         self.states = list(states)
-        # per-op device counters (reference GPU Stats_Record fields num_kernels /
-        # batches, wf/stats_record.hpp:76-80) — batch-granular, no device sync
+        # batch counters are per-op; ops[from_op:] execute as ONE fused compiled
+        # program, so num_kernels counts ONE launch, attributed to the entry op
+        # (reference GPU Stats_Record fields, wf/stats_record.hpp:76-80)
         for j in range(from_op, len(self.ops)):
             rec = self.ops[j].get_StatsRecords()[0]
             rec.batches_received += 1
             rec.batches_sent += 1
-            rec.num_kernels += 1
+        if self.ops:
+            self.ops[from_op].get_StatsRecords()[0].num_kernels += 1
         return out
 
     def flush(self) -> List[Batch]:
