@@ -120,43 +120,102 @@ def bench_keyed_cb():
     return STEPS * BATCH / dt, dt / STEPS
 
 
-def bench_ysb_latency(batch: int = 1 << 16, steps: int = 60):
-    """p99 window-result latency: per-batch blocking latency through the full YSB
-    chain at a latency-oriented batch size. Each step is synchronized (no pipeline
-    overlap), so a step's wall time bounds the time from a tuple entering the chain
-    to its window result leaving — the p99 of the north-star metric."""
+def measure_floor():
+    """The host<->device synchronization floor of THIS environment, measured so
+    latency numbers decompose honestly. On the tunneled dev chip the first D2H
+    fetch switches the link into real-transfer mode whose round trip is ~67 ms
+    (measured below); on a local PJRT host the same probe reads ~0.1 ms. Every
+    latency we report includes this floor — the device-side component is
+    (raw - rtt)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.zeros((16,))
+    _ = np.asarray(x)                     # enter real-transfer mode
+    f = jax.jit(lambda x: x + 1.0)
+    jax.block_until_ready(f(x))
+    rtt = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        rtt.append(time.perf_counter() - t0)
+    rtt.sort()
+    big = jax.device_put(np.zeros(1 << 20, np.float32))
+    jax.block_until_ready(big)
+    t0 = time.perf_counter()
+    _ = np.asarray(big)
+    d2h_s = time.perf_counter() - t0
+    return {"sync_rtt_ms": rtt[len(rtt) // 2] * 1e3,
+            "d2h_mbps": 4.0 / d2h_s}
+
+
+def bench_latency_curve(batches=(4096, 16384, 65536, 262144), steps: int = 80,
+                        depth: int = 2):
+    """Per-window-result latency, measured the reference's way
+    (``ysb_nodes.hpp:200-216``): emission timestamp -> host receipt, per result.
+
+    A batch's tuples are "emitted" when the batch is submitted (ship_time); its
+    window results are received when their async D2H copy lands on the host
+    (receipt_time). The loop runs PIPELINED with ``depth`` batches in flight
+    (bounded-queue backpressure — the reference's FF_BOUNDED_BUFFER role): the
+    device computes batch i while results of batch i-depth are harvested, so
+    latency ~= depth * step_time + transfer, not a blocking sync per batch.
+    Window results ship as ONE packed [4, W] i32 array (key, wid, count, valid)
+    to cost a single transfer per batch."""
     import jax
     import jax.numpy as jnp
     from windflow_tpu.benchmarks import ysb
+    from windflow_tpu.runtime.async_sink import AsyncResultShipper
     from windflow_tpu.runtime.pipeline import CompiledChain
 
-    panes_per_batch = max(batch // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN), 1) + 1
-    src = ysb.make_source(total=(steps + 2) * batch)
-    ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
-                       max_wins=panes_per_batch + 64)
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
+    out_rows = []
+    for batch in batches:
+        panes_per_batch = max(batch // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN), 1) + 1
+        src = ysb.make_source(total=(steps + 4) * batch)
+        ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
+                           max_wins=panes_per_batch + 64)
+        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
 
-    def step(states, start):
-        b = src.make_batch(jnp.asarray(start, jnp.int32), batch)
-        states = list(states)
-        for j, op in enumerate(chain.ops):
-            states[j], b = op.apply(states[j], b)
-        return tuple(states), b.valid
+        def step(states, start):
+            b = src.make_batch(jnp.asarray(start, jnp.int32), batch)
+            states = list(states)
+            for j, op in enumerate(chain.ops):
+                states[j], b = op.apply(states[j], b)
+            packed = jnp.stack([b.key, b.id,
+                                jnp.asarray(b.payload, jnp.int32),
+                                b.valid.astype(jnp.int32)])
+            return tuple(states), packed
 
-    step = jax.jit(step, donate_argnums=0)
-    states = tuple(chain.states)
-    states, out = step(states, 0)
-    jax.block_until_ready(out)
-    lat = []
-    for i in range(1, steps + 1):
-        t0 = time.perf_counter()
-        states, out = step(states, i * batch)
-        jax.block_until_ready(out)              # synchronous: true per-batch latency
-        lat.append(time.perf_counter() - t0)
-    lat.sort()
-    p50 = lat[len(lat) // 2]
-    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
-    return p50, p99, batch / (sum(lat) / len(lat))
+        step = jax.jit(step, donate_argnums=0)
+        states = tuple(chain.states)
+        states, packed = step(states, 0)
+        jax.block_until_ready(packed)                     # compile outside timing
+
+        shipper = AsyncResultShipper(depth=depth)
+        lat = []
+        n_results = 0
+        t_wall0 = time.perf_counter()
+        for i in range(1, steps + 1):
+            states, packed = step(states, i * batch)      # async dispatch
+            shipper.ship(packed, tag=i)
+            for rec in shipper.harvest():                 # blocks only past depth
+                lat.append(rec.receipt_time - rec.ship_time)
+                n_results += int((rec.value[3] > 0).sum())
+        for rec in shipper.drain():
+            lat.append(rec.receipt_time - rec.ship_time)
+            n_results += int((rec.value[3] > 0).sum())
+        t_wall = time.perf_counter() - t_wall0
+        lat.sort()
+        out_rows.append({
+            "batch": batch,
+            "p50_ms": lat[len(lat) // 2] * 1e3,
+            "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3,
+            "tput_mtps": steps * batch / t_wall / 1e6,
+            "step_ms": t_wall / steps * 1e3,
+            "results": n_results,
+        })
+    return out_rows
 
 
 def bench_keyed_stateful(num_keys: int):
@@ -264,10 +323,21 @@ def main():
           f"batch={BATCH})", file=sys.stderr)
     print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "
           f"({sl_step_s*1e3:.2f} ms/step)", file=sys.stderr)
-    lat_p50, lat_p99, lat_tps = bench_ysb_latency()
-    print(f"window-result latency (batch=65536, synchronous): "
-          f"p50 {lat_p50*1e3:.2f} ms, p99 {lat_p99*1e3:.2f} ms "
-          f"(at {lat_tps/1e6:.1f} M t/s)", file=sys.stderr)
+    floor = measure_floor()
+    print(f"environment floor: sync round trip {floor['sync_rtt_ms']:.2f} ms, "
+          f"D2H {floor['d2h_mbps']:.1f} MB/s  (tunnel artifact — local PJRT "
+          f"measures ~0.1 ms; all latencies below INCLUDE this floor)",
+          file=sys.stderr)
+    for depth, tag in ((2, "latency-oriented"), (12, "throughput-oriented")):
+        curve = bench_latency_curve(depth=depth)
+        print(f"window-result latency curve (emission->host receipt, pipelined "
+              f"depth={depth}, {tag}):", file=sys.stderr)
+        for r in curve:
+            dev_p99 = max(r["p99_ms"] - floor["sync_rtt_ms"], r["step_ms"])
+            print(f"  batch={r['batch']:6d}: p50 {r['p50_ms']:7.2f} ms  "
+                  f"p99 {r['p99_ms']:7.2f} ms  @ {r['tput_mtps']:6.1f} M t/s  "
+                  f"(step {r['step_ms']:.2f} ms; device-side p99 bound "
+                  f"~{dev_p99:.2f} ms)", file=sys.stderr)
     if os.environ.get("WF_BENCH_ALL"):
         kc_tps, kc_step = bench_keyed_cb()
         print(f"keyed CB sliding windows (K=512, w=1024 s=512): "

@@ -1,0 +1,35 @@
+"""AsyncResultShipper: overlapped device->host result shipping (the latency-path
+sink; reference D2H overlap discipline, wf/win_seq_gpu.hpp:243-260,524)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from windflow_tpu.runtime.async_sink import AsyncResultShipper
+
+
+def test_ship_harvest_ordering_and_depth():
+    sh = AsyncResultShipper(depth=2)
+    f = jax.jit(lambda i: {"a": jnp.full((4,), i), "b": jnp.asarray(i * 2)})
+    for i in range(5):
+        sh.ship(f(i), tag=i)
+    got = sh.harvest()                 # leaves 2 in flight
+    assert [r.tag for r in got] == [0, 1, 2]
+    assert len(sh) == 2
+    rest = sh.drain()
+    assert [r.tag for r in rest] == [3, 4]
+    assert len(sh) == 0
+    for r in got + rest:
+        np.testing.assert_array_equal(r.value["a"], np.full((4,), r.tag))
+        assert int(r.value["b"]) == r.tag * 2
+        assert isinstance(r.value["a"], np.ndarray)
+        assert r.receipt_time >= r.ship_time
+
+
+def test_harvest_empty_and_keep_inflight():
+    sh = AsyncResultShipper(depth=4)
+    assert sh.harvest() == []
+    sh.ship(jnp.zeros(3), tag="x")
+    assert sh.harvest() == []          # still within depth
+    [r] = sh.harvest(keep_inflight=0)
+    assert r.tag == "x"

@@ -25,6 +25,7 @@ from .operators.win_seqffat import Win_SeqFFAT
 from .operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT, Pane_Farm,
                                      Win_MapReduce, Nested_Farm)
 from .runtime import CompiledChain, Pipeline, Stats_Record
+from .runtime.async_sink import AsyncResultShipper, ShippedResult
 from .runtime.pipegraph import PipeGraph, MultiPipe
 from .runtime.threaded import ThreadedPipeline
 from .runtime.supervisor import SupervisedPipeline, RestartExhausted
