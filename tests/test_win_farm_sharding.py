@@ -1,0 +1,94 @@
+"""Cross-chip window parallelism (Win_Farm): the fired-window [W] axis partitions
+over the mesh while archives replicate — the WF_Emitter multicast + round-robin
+window ownership (wf/wf_nodes.hpp:157-204, wf/win_farm.hpp:165-175) as sharding
+rules. Oracle: results identical to single-device; evidence: addressable shards
+of the output batch cover W/p rows on each of the 8 virtual devices."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.batch import Batch
+from windflow_tpu.operators.win_patterns import Win_Farm, Pane_Farm
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.parallel import make_mesh, ShardedChain
+from windflow_tpu.runtime.pipeline import CompiledChain
+
+
+def _batches(total, C):
+    out = []
+    for s in range(0, total, C):
+        n = min(C, total - s)
+        ids = np.arange(s, s + C, dtype=np.int32)
+        out.append(Batch(
+            key=jnp.zeros(C, jnp.int32),
+            id=jnp.asarray(ids), ts=jnp.asarray(ids),
+            payload={"v": jnp.asarray((ids % 11).astype(np.float32))},
+            valid=jnp.asarray(np.arange(C) < n)))
+    return out
+
+
+def _collect(outs):
+    acc = []
+    for o in outs:
+        o = jax.tree.map(np.asarray, o)
+        v = o.valid
+        acc.extend(zip(o.key[v].tolist(), o.id[v].tolist(),
+                       np.asarray(jax.tree.leaves(o.payload)[0])[v].tolist()))
+    return sorted(acc)
+
+
+def _run(factory, batches, sharded):
+    spec = {"v": jax.ShapeDtypeStruct((), jnp.float32)}
+    chain = CompiledChain(factory(), spec, batch_capacity=batches[0].capacity)
+    if sharded:
+        sc = ShardedChain(chain, make_mesh(8))
+        outs = [sc.push(b) for b in batches]
+        outs += sc.flush()
+        return _collect(outs), outs
+    outs = [chain.push(b) for b in batches]
+    outs += chain.flush()
+    return _collect(outs), outs
+
+
+def test_win_farm_window_axis_sharded_matches_oracle():
+    factory = lambda: [Win_Farm(lambda wid, it: it.sum("v"),
+                                WindowSpec(16, 8, win_type_t.CB),
+                                parallelism=8, max_wins=32)]
+    batches = _batches(512, 128)
+    single, _ = _run(factory, batches, sharded=False)
+    multi, outs = _run(factory, batches, sharded=True)
+    assert single == multi and len(single) > 0
+
+    # W axis verifiably partitioned: 8 addressable shards, each W/8 rows
+    out = outs[0]
+    shards = out.key.addressable_shards
+    assert len(shards) == 8
+    W = out.key.shape[0]
+    assert all(s.data.shape[0] == W // 8 for s in shards)
+    assert len({s.device for s in shards}) == 8
+
+
+def test_win_farm_tb_window_axis_sharded_matches_oracle():
+    factory = lambda: [Win_Farm(lambda wid, it: it.max("v"),
+                                WindowSpec(20, 10, win_type_t.TB),
+                                parallelism=8, max_wins=32, tb_capacity=256)]
+    batches = _batches(400, 80)
+    single, _ = _run(factory, batches, sharded=False)
+    multi, _ = _run(factory, batches, sharded=True)
+    assert single == multi and len(single) > 0
+
+
+def test_nested_win_farm_pane_farm_sharded():
+    def factory():
+        inner = Pane_Farm(lambda wid, it: it.sum("v"),
+                          lambda wid, it: it.sum(),
+                          WindowSpec(16, 8, win_type_t.CB), num_keys=1,
+                          max_wins=64)
+        return [Win_Farm(inner, parallelism=8)]
+    batches = _batches(384, 128)
+    single, _ = _run(factory, batches, sharded=False)
+    multi, _ = _run(factory, batches, sharded=True)
+    assert single == multi and len(single) > 0

@@ -164,6 +164,10 @@ class Nested_Farm(Basic_Operator):
     def flush(self, state):
         return self.inner.flush(state)
 
+    def set_window_sharding(self, mesh, axis: str) -> None:
+        if hasattr(self.inner, "set_window_sharding"):
+            self.inner.set_window_sharding(mesh, axis)
+
 
 class Pane_Farm(Basic_Operator):
     """Pane decomposition (Li et al. SIGMOD'05; ``wf/pane_farm.hpp``).
@@ -223,6 +227,10 @@ class Pane_Farm(Basic_Operator):
         """Pane results enter WLQ as a tuple stream; for TB mode their ts must be the
         pane close time (set by Win_Seq already for TB panes)."""
         return panes
+
+    def set_window_sharding(self, mesh, axis: str) -> None:
+        self.plq.set_window_sharding(mesh, axis)
+        self.wlq.set_window_sharding(mesh, axis)
 
     def apply(self, state, batch: Batch):
         st_p, panes = self.plq.apply(state["plq"], batch)
@@ -306,6 +314,9 @@ class Win_MapReduce(Basic_Operator):
 
     def out_spec(self, payload_spec: Any) -> Any:
         return self.engine.out_spec(payload_spec)
+
+    def set_window_sharding(self, mesh, axis: str) -> None:
+        self.engine.set_window_sharding(mesh, axis)
 
     def apply(self, state, batch: Batch):
         return self.engine.apply(state, batch)

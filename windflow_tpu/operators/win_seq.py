@@ -79,6 +79,7 @@ class Win_Seq(Basic_Operator):
         self.A = None                  # resolved in bind_geometry
         self.max_wins = max_wins       # resolved at first apply if None
         self._w = None
+        self._wshard = None            # (mesh, axis): shard the fired-window W axis
         self.bind_geometry(256)        # provisional; compiler re-binds with real C
 
     def bind_geometry(self, batch_capacity: int) -> None:
@@ -177,6 +178,23 @@ class Win_Seq(Basic_Operator):
                 f"per-batch fired-window budget")
         return W
 
+    def set_window_sharding(self, mesh, axis: str) -> None:
+        """Cross-chip window parallelism (Win_Farm's distribution,
+        ``wf/wf_nodes.hpp:157-204`` / ``wf/win_farm.hpp:165-175``): partition the
+        fired-window [W] axis over mesh axis ``axis``. The archive stays replicated
+        (every chip sees every tuple — the WF_Emitter multicast as a sharding rule);
+        each chip gathers and computes only its W/p window rows."""
+        self._wshard = (mesh, axis)
+
+    def _wsc(self, a):
+        """Constrain the leading (window) axis of ``a`` to the window mesh axis."""
+        if self._wshard is None:
+            return a
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh, axis = self._wshard
+        spec = P(axis, *([None] * (a.ndim - 1)))
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+
     def _fired_range(self, state: WinSeqState, flush: bool):
         s = self.spec
         if s.is_cb:
@@ -195,11 +213,11 @@ class Win_Seq(Basic_Operator):
         csum = jnp.cumsum(n_f)
         off = csum - n_f
         total = csum[-1] if K > 0 else jnp.asarray(0, CTRL_DTYPE)
-        w_idx = jnp.arange(W, dtype=CTRL_DTYPE)
+        w_idx = self._wsc(jnp.arange(W, dtype=CTRL_DTYPE))
         k_of = jnp.searchsorted(csum, w_idx, side="right").astype(CTRL_DTYPE)
-        k_safe = jnp.minimum(k_of, K - 1)
-        wid = jnp.take(lo, k_safe) + (w_idx - jnp.take(off, k_safe))
-        valid_w = w_idx < jnp.minimum(total, W)
+        k_safe = self._wsc(jnp.minimum(k_of, K - 1))
+        wid = self._wsc(jnp.take(lo, k_safe) + (w_idx - jnp.take(off, k_safe)))
+        valid_w = self._wsc(w_idx < jnp.minimum(total, W))
 
         # advance next_win past emitted windows
         emitted_k = jnp.clip(jnp.minimum(total, W) - off, 0, n_f)
@@ -239,15 +257,17 @@ class Win_Seq(Basic_Operator):
             # only triggers on tuples); filter empty windows from the emission
             valid_w = valid_w & jnp.any(content_mask, axis=1)
 
-        it = Iterable(data=data, ids=ids, ts=tss, mask=content_mask)
+        it = Iterable(data=jax.tree.map(self._wsc, data), ids=self._wsc(ids),
+                      ts=self._wsc(tss), mask=self._wsc(content_mask))
         if self.incremental:
             results = _fold_windows(self.win_fn, wid, it, self.init_acc)
         else:
             results = jax.vmap(self.win_fn)(wid, it)
 
         out = Batch(key=k_safe, id=wid,
-                    ts=res_ts if s.is_cb else jnp.asarray(res_ts, CTRL_DTYPE),
-                    payload=results, valid=valid_w)
+                    ts=self._wsc(res_ts if s.is_cb
+                                 else jnp.asarray(res_ts, CTRL_DTYPE)),
+                    payload=jax.tree.map(self._wsc, results), valid=valid_w)
         return dataclasses.replace(state, next_win=new_next), out
 
     # ------------------------------------------------------------------ operator API

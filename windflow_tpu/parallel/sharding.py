@@ -26,13 +26,22 @@ from ..runtime.pipeline import CompiledChain
 
 
 def _state_sharding(op, state, mesh: Mesh, axis: str):
-    """Shard rule for one operator's state pytree: keyed tables shard the leading
-    (key) axis; scalars/small states replicate."""
+    """Shard rule for one operator's state pytree, dispatched on the op's declared
+    ``shard_axis``:
+
+    - ``"key"`` (Key_Farm/Key_FFAT): leaves whose leading dim is the op's key-table
+      size shard their key axis (KF_Emitter whole-key routing as a placement rule);
+      everything else replicates.
+    - ``"window"`` (Win_Farm): the state (archive rings) REPLICATES — every chip
+      sees every tuple, the WF_Emitter multicast (``wf/wf_nodes.hpp:182-204``) as a
+      sharding rule — and the fired-window [W] axis partitions *inside* the program
+      via the ``with_sharding_constraint`` set by :meth:`Win_Seq.set_window_sharding`.
+    """
     shard_axis = getattr(op, "shard_axis", "key")
     num_keys = getattr(op, "num_keys", None)
 
     def place(leaf):
-        if (shard_axis in ("key", "window") and num_keys is not None
+        if (shard_axis == "key" and num_keys is not None
                 and getattr(leaf, "ndim", 0) >= 1
                 and leaf.shape[0] == num_keys and num_keys % mesh.devices.size == 0):
             return NamedSharding(mesh, P(axis))
@@ -53,10 +62,16 @@ class ShardedChain:
     """Wraps a :class:`CompiledChain`, placing its states on the mesh so every
     ``push``/``flush`` runs as one GSPMD-partitioned program."""
 
-    def __init__(self, chain: CompiledChain, mesh: Mesh, axis: str = "dp"):
+    def __init__(self, chain: CompiledChain, mesh: Mesh, axis: str = "dp",
+                 win_axis: Optional[str] = None):
         self.chain = chain
         self.mesh = mesh
         self.axis = axis
+        for op in chain.ops:
+            if (getattr(op, "shard_axis", None) == "window"
+                    and hasattr(op, "set_window_sharding")):
+                op.set_window_sharding(mesh, win_axis or axis)
+        chain._steps = {}        # drop programs traced before shardings were set
         chain.states = [
             jax.device_put(st, _state_sharding(op, st, mesh, axis)) if st is not None
             else None
