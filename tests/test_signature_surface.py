@@ -1,0 +1,152 @@
+"""The full user-function signature surface, deduced — one test per accepted
+flavour per operator, plus rejection messages carrying the catalogue
+(reference: wf/meta.hpp:49-877 static dispatch, /root/reference/API)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.meta import SignatureError, classify_source_flavour, \
+    classify_window_flavour
+from windflow_tpu.operators.window import WindowSpec
+
+
+def run_pipeline(src, ops, batch_size=32):
+    out = []
+
+    def cb(view):
+        if view is None:
+            return
+        v = view["payload"]
+        leaf = v["v"] if isinstance(v, dict) else v
+        out.extend(np.asarray(leaf).tolist())
+
+    wf.Pipeline(src, ops, wf.Sink(cb), batch_size=batch_size).run()
+    return out
+
+
+def _src(total=96):
+    return wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=total,
+                     num_keys=2)
+
+
+# ---- MAP: in-place vs non-in-place (wf/map.hpp:64-74) --------------------------
+
+def test_map_non_in_place():
+    got = run_pipeline(_src(), [wf.Map(lambda t: {"v": t.v * 2})])
+    assert got == [2.0 * i for i in range(96)]
+
+
+def test_map_in_place():
+    def f(t):
+        t.v = t.v * 2          # void(tuple_t&): mutate, return nothing
+    got = run_pipeline(_src(), [wf.Map(f)])
+    assert got == [2.0 * i for i in range(96)]
+
+
+def test_map_in_place_new_field():
+    def f(t):
+        t.w = t.v + 1          # in-place maps may add payload fields
+    src = _src(32)
+    p = wf.Pipeline(src, [wf.Map(f)], batch_size=32)
+    outs = p.chain.push(next(iter(src.batches(32))))
+    assert set(outs.payload.keys()) == {"v", "w"}
+
+
+def test_map_control_fields_read_only_in_place():
+    def f(t):
+        t.key = t.key + 1
+    with pytest.raises(Exception, match="read-only"):
+        run_pipeline(_src(32), [wf.Map(f)])
+
+
+# ---- FILTER: predicate vs optional (wf/filter.hpp:63-76) -----------------------
+
+def test_filter_predicate():
+    got = run_pipeline(_src(), [wf.Filter(lambda t: t.v % 2 == 0)])
+    assert got == [float(i) for i in range(0, 96, 2)]
+
+
+def test_filter_optional_transforming():
+    # std::optional<result_t>(const tuple_t&): transform + keep flag in one fn
+    got = run_pipeline(_src(), [wf.Filter(lambda t: ({"v": t.v * 10},
+                                                     t.v % 3 == 0))])
+    assert got == [10.0 * i for i in range(0, 96, 3)]
+
+
+def test_filter_bad_tuple_rejected():
+    with pytest.raises(SignatureError, match="FILTER"):
+        run_pipeline(_src(32), [wf.Filter(lambda t: (t.v, t.v, t.v))])
+
+
+# ---- SOURCE: itemized vs loop (wf/meta.hpp:49-88) ------------------------------
+
+def test_source_itemized_flavour():
+    assert classify_source_flavour(lambda i: {"v": i}) == (False, False)
+    assert classify_source_flavour(lambda i, ctx: {"v": i}) == (False, True)
+
+
+def test_source_loop_flavour():
+    def f(i, shipper):
+        shipper.push({"v": i.astype(jnp.float32)})
+        shipper.push({"v": (i + 100).astype(jnp.float32)}, when=i % 2 == 0)
+    src = wf.Source(f, total=8, max_fanout=2)
+    got = sorted(run_pipeline(src, [wf.Map(lambda t: {"v": t.v})], batch_size=8))
+    want = sorted([float(i) for i in range(8)] +
+                  [float(i + 100) for i in range(0, 8, 2)])
+    assert got == want
+
+
+def test_source_bad_signature_rejected():
+    # 3 positional params whose 2nd is not a shipper: matches no flavour
+    with pytest.raises(SignatureError, match="SOURCE"):
+        wf.Source(lambda i, extra_thing, more: {"v": i}, total=8)
+
+
+def test_window_rich_flavours_run():
+    spec = WindowSpec(8, 8, win_type_t.CB)
+    seen = []
+
+    def rich_noninc(wid, it, ctx):
+        seen.append(ctx)
+        return it.sum("v")
+
+    got = run_pipeline(_src(), [wf.Win_Seq(rich_noninc, spec, num_keys=2)])
+    assert len(got) == 12 and seen and seen[0].getParallelism() == 1
+
+    def rich_inc(wid, t, acc, ctx):
+        return acc + t.v
+
+    inc = run_pipeline(_src(), [wf.Win_Seq(rich_inc, spec,
+                                           init_acc=jnp.float32(0), num_keys=2)])
+    assert sorted(inc) == sorted(got)
+
+
+# ---- WINDOW: non-incremental vs incremental deduced ----------------------------
+
+def test_window_flavour_classifier():
+    assert classify_window_flavour(lambda wid, it: it.sum()) == (False, False)
+    assert classify_window_flavour(lambda wid, it, ctx: it.sum()) == (False, True)
+    assert classify_window_flavour(lambda wid, t, acc: acc + t.v) == (True, False)
+    with pytest.raises(SignatureError, match="WIN_FARM|KEY_FARM"):
+        classify_window_flavour(lambda a, b, c, d, e: None)
+
+
+def test_win_seq_deduces_incremental():
+    spec = WindowSpec(8, 8, win_type_t.CB)
+    inc = wf.Win_Seq(lambda wid, t, acc: acc + t.v, spec, init_acc=jnp.float32(0),
+                     num_keys=2)
+    noninc = wf.Win_Seq(lambda wid, it: it.sum("v"), spec, num_keys=2)
+    assert inc.incremental and not noninc.incremental
+    a = run_pipeline(_src(), [inc])
+    b = run_pipeline(_src(), [noninc])
+    assert sorted(a) == sorted(b) and len(a) == 12
+
+
+def test_win_seq_incremental_requires_init_acc():
+    with pytest.raises(ValueError, match="init_acc"):
+        wf.Win_Seq(lambda wid, t, acc: acc + t.v,
+                   WindowSpec(8, 8, win_type_t.CB), num_keys=2)

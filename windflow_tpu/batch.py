@@ -235,3 +235,44 @@ class TupleRef:
 def tuple_refs(batch: Batch) -> TupleRef:
     """Batched TupleRef (each field keeps its capacity axis; vmap strips it)."""
     return TupleRef(key=batch.key, id=batch.id, ts=batch.ts, data=batch.payload)
+
+
+class MutableTupleRef:
+    """Mutable per-tuple view backing the reference's *in-place* signatures
+    (``void(tuple_t&)`` Map, ``wf/map.hpp:64-74``): payload attribute writes are
+    recorded during tracing and become the output payload. Control fields stay
+    read-only (the reference mutates them only via ``setControlFields``, which
+    routing owns here). Requires a dict payload (named fields)."""
+
+    __slots__ = ("_ctrl", "_data")
+
+    def __init__(self, ref: TupleRef):
+        object.__setattr__(self, "_ctrl",
+                           {"key": ref.key, "id": ref.id, "ts": ref.ts})
+        data = ref.data
+        if not isinstance(data, dict):
+            raise TypeError(
+                "in-place map functions need a dict payload (named fields); "
+                "return a new payload instead for pytree payloads")
+        object.__setattr__(self, "_data", dict(data))
+
+    def __getattr__(self, name):
+        ctrl = object.__getattribute__(self, "_ctrl")
+        if name in ctrl:
+            return ctrl[name]
+        data = object.__getattribute__(self, "_data")
+        if name == "data":
+            return data
+        if name in data:
+            return data[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name in ("key", "id", "ts"):
+            raise TypeError(
+                f"control field '{name}' is read-only in user functions (the "
+                f"reference owns setControlFields in its routing layer)")
+        object.__getattribute__(self, "_data")[name] = value
+
+    def _payload(self):
+        return dict(object.__getattribute__(self, "_data"))

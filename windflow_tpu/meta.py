@@ -66,9 +66,80 @@ def classify(fn: Callable, *, base_arity: int, what: str, accepted: str):
         f" rich variant — wf/meta.hpp semantics)")
 
 
+#: parameter names marking a Shipper parameter (loop-style Source flavour)
+SHIPPER_PARAM_NAMES = ("shipper", "ship", "out", "emit")
+
+SOURCE_CATALOGUE = """\
+  f(i) -> payload                       (itemized; bool(tuple_t&) analogue)
+  f(i, ctx) -> payload                  (itemized rich)
+  f(i, shipper) -> None                 (loop; bool(Shipper<tuple_t>&) analogue)
+  f(i, shipper, ctx) -> None            (loop rich)
+(catalogue: /root/reference/API SOURCE; the shipper parameter must be named one
+of %s, the context parameter one of %s)""" % (SHIPPER_PARAM_NAMES,
+                                              RICH_PARAM_NAMES)
+
+WINDOW_CATALOGUE = """\
+  f(wid, iterable) -> result            (non-incremental)
+  f(wid, iterable, ctx) -> result       (non-incremental rich)
+  f(wid, t, acc) -> acc                 (incremental; winupdate)
+  f(wid, t, acc, ctx) -> acc            (incremental rich)
+(catalogue: /root/reference/API KEY_FARM/WIN_FARM; the context parameter must be
+named one of %s)""" % (RICH_PARAM_NAMES,)
+
+
 def classify_source(fn):
     return classify(fn, base_arity=1, what="Source",
                     accepted="f(i) -> payload | f(i, ctx) -> payload")
+
+
+def classify_source_flavour(fn):
+    """Deduce the Source flavour: ``(loop, is_rich)``.
+
+    The reference accepts itemized ``bool(tuple_t&)`` and loop ``bool(Shipper&)``
+    sources (+rich; ``wf/meta.hpp:49-88``, ``/root/reference/API``). Here the
+    itemized form is ``f(i) -> payload`` and the loop form ``f(i, shipper)`` —
+    the shipper records 0..max_fanout pushes per index (``when=`` masks make
+    emission data-dependent)."""
+    params = _positional_params(fn)
+    if params is None:
+        return False, False
+    names = [p.name for p in params]
+    n = len(names)
+    if n == 1:
+        return False, False
+    if n == 2:
+        # a shipper-named 2nd param selects the loop flavour; any other name is
+        # treated as the context (the itemized rich form — arity compatibility
+        # with plain classify_source)
+        return (True, False) if names[1] in SHIPPER_PARAM_NAMES else (False, True)
+    if n == 3 and names[1] in SHIPPER_PARAM_NAMES:
+        return True, True
+    raise SignatureError(
+        f"Source: callable with positional parameters {names} matches no accepted "
+        f"signature:\n{SOURCE_CATALOGUE}")
+
+
+def classify_window_flavour(fn):
+    """Deduce the window-function flavour: ``(incremental, is_rich)``.
+
+    The reference dispatches non-incremental ``void(wid, Iterable&, result&)`` vs
+    incremental ``void(wid, tuple&, result&)`` statically (``wf/meta.hpp`` window
+    families); here arity separates them (2 vs 3 args) with the trailing
+    context-named parameter marking rich forms."""
+    params = _positional_params(fn)
+    if params is None:
+        return False, False
+    names = [p.name for p in params]
+    n = len(names)
+    if n == 2:
+        return False, False
+    if n == 3:
+        return (False, True) if names[-1] in RICH_PARAM_NAMES else (True, False)
+    if n == 4 and names[-1] in RICH_PARAM_NAMES:
+        return True, True
+    raise SignatureError(
+        f"Window function: callable with positional parameters {names} matches no "
+        f"accepted signature:\n{WINDOW_CATALOGUE}")
 
 
 def classify_map(fn):

@@ -20,13 +20,23 @@ import jax
 import jax.numpy as jnp
 
 from ..basic import routing_modes_t
-from ..batch import Batch, tuple_refs
+from ..batch import Batch, TupleRef, tuple_refs
 from ..context import RuntimeContext
 from ..meta import classify_filter
 from .base import Basic_Operator
 
 
 class Filter(Basic_Operator):
+    """Both reference Filter flavours through one constructor, deduced from the
+    return value (``wf/filter.hpp:63-76``, ``/root/reference/API`` FILTER):
+
+    - predicate  ``f(t) -> bool``: intersects the validity mask;
+    - optional   ``f(t) -> (payload, keep)``: transform + drop in one op — the
+      ``std::optional<result_t>(const tuple_t&)`` signature (``keep`` plays the
+      optional's engaged flag; data-dependent ``None`` is untraceable).
+
+    Rich variants append a context parameter."""
+
     def __init__(self, fn: Callable, *, name: str = "filter", parallelism: int = 1,
                  keyed: bool = False, context: Optional[RuntimeContext] = None):
         super().__init__(name, parallelism)
@@ -35,36 +45,44 @@ class Filter(Basic_Operator):
         self.routing = routing_modes_t.KEYBY if keyed else routing_modes_t.FORWARD
         self.context = context or RuntimeContext(parallelism, 0)
 
-    def apply(self, state, batch: Batch):
-        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
-        keep = jax.vmap(fn)(tuple_refs(batch))
-        return state, batch.mask(jnp.asarray(keep, jnp.bool_))
-
-
-class FilterMap(Basic_Operator):
-    """Transform + drop in one op: ``f(t) -> (payload, keep)`` — the reference's
-    ``optional<result>(const tuple&)`` Filter signature (``wf/filter.hpp:63-76``)."""
-
-    def __init__(self, fn: Callable, *, name: str = "filtermap", parallelism: int = 1,
-                 context: Optional[RuntimeContext] = None):
-        super().__init__(name, parallelism)
-        self.fn = fn
-        self.is_rich = classify_filter(fn)
-        self.context = context or RuntimeContext(parallelism, 0)
+    def _call(self, t):
+        r = (self.fn(t, self.context) if self.is_rich else self.fn(t))
+        if isinstance(r, tuple):
+            if len(r) != 2:
+                from ..meta import SignatureError
+                raise SignatureError(
+                    "Filter: accepted signatures are\n"
+                    "  f(t[, ctx]) -> bool                (predicate)\n"
+                    "  f(t[, ctx]) -> (payload, keep)     (optional/transforming)\n"
+                    f"(catalogue: /root/reference/API FILTER); got a {len(r)}-tuple")
+            return r
+        return r
 
     def out_spec(self, payload_spec: Any) -> Any:
-        from ..batch import TupleRef
         t = TupleRef(key=jax.ShapeDtypeStruct((), jnp.int32),
                      id=jax.ShapeDtypeStruct((), jnp.int32),
                      ts=jax.ShapeDtypeStruct((), jnp.int32), data=payload_spec)
-        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
-        out, _ = jax.eval_shape(fn, t)
-        return out
+        out = jax.eval_shape(self._call, t)
+        return out[0] if isinstance(out, tuple) else payload_spec
 
     def apply(self, state, batch: Batch):
-        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
-        payload, keep = jax.vmap(fn)(tuple_refs(batch))
-        return state, batch.with_payload(payload).mask(jnp.asarray(keep, jnp.bool_))
+        out = jax.vmap(self._call)(tuple_refs(batch))
+        if isinstance(out, tuple):
+            payload, keep = out
+            return state, batch.with_payload(payload).mask(
+                jnp.asarray(keep, jnp.bool_))
+        return state, batch.mask(jnp.asarray(out, jnp.bool_))
+
+
+class FilterMap(Filter):
+    """Named alias for the transforming Filter flavour: ``f(t) -> (payload, keep)``
+    — the reference's ``optional<result>(const tuple&)`` signature
+    (``wf/filter.hpp:63-76``). :class:`Filter` deduces the same flavour from the
+    return value; this class only fixes the default name."""
+
+    def __init__(self, fn: Callable, *, name: str = "filtermap", parallelism: int = 1,
+                 context: Optional[RuntimeContext] = None):
+        super().__init__(fn, name=name, parallelism=parallelism, context=context)
 
 
 class Compact(Basic_Operator):

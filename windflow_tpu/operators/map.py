@@ -32,6 +32,12 @@ from .base import Basic_Operator
 
 
 class Map(Basic_Operator):
+    """Both reference Map flavours through one constructor (``wf/map.hpp:64-74``,
+    deduced like ``wf/meta.hpp``): *non-in-place* ``f(t) -> payload`` returns the
+    new payload; *in-place* ``f(t) -> None`` mutates the tuple's payload fields
+    (``t.v = t.v * 2``) via :class:`MutableTupleRef` — the ``void(tuple_t&)``
+    signature. Rich variants append a context parameter."""
+
     def __init__(self, fn: Callable, *, name: str = "map", parallelism: int = 1,
                  keyed: bool = False, context: Optional[RuntimeContext] = None):
         super().__init__(name, parallelism)
@@ -40,16 +46,27 @@ class Map(Basic_Operator):
         self.routing = routing_modes_t.KEYBY if keyed else routing_modes_t.FORWARD
         self.context = context or RuntimeContext(parallelism, 0)
 
+    def _call(self, t: TupleRef):
+        from ..batch import MutableTupleRef
+        m = MutableTupleRef(t) if isinstance(t.data, dict) else t
+        r = (self.fn(m, self.context) if self.is_rich else self.fn(m))
+        if r is None:
+            if not isinstance(m, MutableTupleRef):
+                from ..meta import SignatureError
+                raise SignatureError(
+                    "Map: f returned None (in-place flavour) but the payload is "
+                    "not a dict of named fields; return the new payload instead")
+            return m._payload()
+        return r
+
     def out_spec(self, payload_spec: Any) -> Any:
         t = TupleRef(key=jax.ShapeDtypeStruct((), jnp.int32),
                      id=jax.ShapeDtypeStruct((), jnp.int32),
                      ts=jax.ShapeDtypeStruct((), jnp.int32), data=payload_spec)
-        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
-        return jax.eval_shape(fn, t)
+        return jax.eval_shape(self._call, t)
 
     def apply(self, state, batch: Batch):
-        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
-        payload = jax.vmap(fn)(tuple_refs(batch))
+        payload = jax.vmap(self._call)(tuple_refs(batch))
         return state, batch.with_payload(payload)
 
 

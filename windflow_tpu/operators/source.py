@@ -34,11 +34,76 @@ from ..meta import classify_source
 from .base import Basic_Operator
 
 
+def prefetch_to_device(host_batches: Iterator[Batch], depth: int = 3
+                       ) -> Iterator[Batch]:
+    """Double-buffered host->device ingest: a worker thread pulls host batches,
+    starts their (asynchronous) ``jax.device_put`` transfers, and keeps up to
+    ``depth`` in flight in a bounded queue — H2D transfer of batch N+1 overlaps
+    device compute of batch N. This is the reference GPU operators' pinned-buffer
+    ``cudaMemcpyAsync`` + double-buffering protocol (``wf/map_gpu_node.hpp:224-340``)
+    at the source boundary. Exceptions in the worker re-raise at the consumer."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+    END, ERR = object(), object()
+    stop = threading.Event()        # consumer gone: let the worker exit
+
+    def put_guarded(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for hb in host_batches:
+                if not put_guarded(jax.device_put(hb)):
+                    return
+            put_guarded(END)
+        except BaseException as e:      # noqa: BLE001 — re-raised at consumer
+            put_guarded((ERR, e))
+
+    threading.Thread(target=worker, daemon=True, name="wf-prefetch").start()
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is ERR:
+                raise item[1]
+            yield item
+    finally:
+        # runs on normal exhaustion AND on early close/GC of the generator:
+        # unblocks (and thereby terminates) the worker, freeing queued batches
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+
 class SourceBase(Basic_Operator):
     routing = routing_modes_t.NONE
 
     def batches(self, batch_size: int) -> Iterator[Batch]:
         raise NotImplementedError
+
+    def out_capacity(self, batch_size: int) -> int:
+        """Capacity of emitted batches (loop-flavour sources expand by fan-out)."""
+        return batch_size
+
+    def batches_prefetched(self, batch_size: int = DEFAULT_BATCH_SIZE,
+                           depth: int = 3) -> Iterator[Batch]:
+        """The ingest-overlap path: host framing + H2D transfers run in a worker
+        thread ``depth`` batches ahead of the consumer (bounded — backpressure)."""
+        host_iter = getattr(self, "_host_batches", None)
+        src = host_iter(batch_size) if host_iter else self.batches(batch_size)
+        return prefetch_to_device(src, depth)
 
     def payload_spec(self) -> Any:
         raise NotImplementedError
@@ -64,7 +129,9 @@ class SourceBase(Basic_Operator):
                next_id: int) -> Batch:
         """Shared host-batch assembly: zero-pad every column to ``batch_size``,
         assign progressive ids, mask the tail. ``payload`` is a pytree of numpy
-        arrays with leading size ``n``; ``key``/``ts`` are [n] arrays or None."""
+        arrays with leading size ``n``; ``key``/``ts`` are [n] arrays or None.
+        Returns a HOST batch (numpy leaves) — the caller device_puts it, so the
+        prefetch path can overlap the transfer."""
         if n > batch_size:
             raise ValueError(f"{self.name}: chunk of {n} tuples > "
                              f"batch_size={batch_size}")
@@ -75,12 +142,12 @@ class SourceBase(Basic_Operator):
             return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
         ids = np.arange(next_id, next_id + batch_size, dtype=np.int32)
         return Batch(
-            key=jnp.asarray(pad_to(key).astype(np.int32) if key is not None
-                            else np.zeros(batch_size, np.int32)),
-            id=jnp.asarray(ids),
-            ts=jnp.asarray(pad_to(ts).astype(np.int32) if ts is not None else ids),
-            payload=jax.tree.map(lambda a: jnp.asarray(pad_to(a)), payload),
-            valid=jnp.asarray(np.arange(batch_size) < n),
+            key=(pad_to(key).astype(np.int32) if key is not None
+                 else np.zeros(batch_size, np.int32)),
+            id=ids,
+            ts=pad_to(ts).astype(np.int32) if ts is not None else ids,
+            payload=jax.tree.map(pad_to, payload),
+            valid=np.arange(batch_size) < n,
         )
 
 
@@ -88,33 +155,87 @@ class DeviceSource(SourceBase):
     """Synthetic on-device source: ``payload = vmap(f)(global_index)``.
 
     ``f`` runs inside the same compiled program as the downstream chain, so generation
-    fuses with the first operators (zero host->device traffic)."""
+    fuses with the first operators (zero host->device traffic).
+
+    Both reference Source flavours are accepted, deduced from the signature
+    (``wf/meta.hpp:49-88``, ``/root/reference/API`` SOURCE):
+
+    - itemized ``f(i) -> payload`` — fill one tuple per index (``bool(tuple_t&)``);
+    - loop ``f(i, shipper) -> None`` — push 0..``max_fanout`` tuples per index via
+      :class:`~windflow_tpu.shipper.Shipper` (``bool(Shipper&)``); ``when=`` masks
+      make the per-index emission count data-dependent with static shapes.
+    """
 
     def __init__(self, fn: Callable, total: int, *, name: str = "source",
                  parallelism: int = 1, key_fn: Callable = None, ts_fn: Callable = None,
-                 num_keys: int = 1, context: Optional[RuntimeContext] = None):
+                 num_keys: int = 1, max_fanout: int = 4,
+                 context: Optional[RuntimeContext] = None):
         super().__init__(name, parallelism)
         self.fn = fn
-        self.is_rich = classify_source(fn)
+        from ..meta import classify_source_flavour
+        self.is_loop, self.is_rich = classify_source_flavour(fn)
         self.total = int(total)
         self.key_fn = key_fn
         self.ts_fn = ts_fn
         self.num_keys = num_keys
+        self.max_fanout = int(max_fanout)
         self.context = context or RuntimeContext(parallelism, 0)
+
+    def out_capacity(self, batch_size: int) -> int:
+        return batch_size * self.max_fanout if self.is_loop else batch_size
+
+    def _loop_one(self, i, key, ts):
+        """Loop flavour: record the pushes of one index (FlatMap-style stacking)."""
+        from ..shipper import Shipper
+        sh = Shipper(self.max_fanout)
+        if self.is_rich:
+            self.fn(i, sh, self.context)
+        else:
+            self.fn(i, sh)
+        payloads, whens, keys, tss = sh._recorded()
+        n = len(payloads)
+        if n == 0:
+            raise ValueError(f"{self.name}: loop source pushed nothing (need >=1 "
+                             f"traced push; use when=False for no-emit)")
+        F = self.max_fanout
+        pay = payloads + [payloads[0]] * (F - n)
+        whn = whens + [jnp.asarray(False)] * (F - n)
+        ks = [k if k is not None else key for k in keys] + [key] * (F - n)
+        xs = [x if x is not None else ts for x in tss] + [ts] * (F - n)
+        stack = lambda seq: jax.tree.map(lambda *ls: jnp.stack(ls), *seq)
+        return (stack(pay), jnp.stack(whn),
+                jnp.stack([jnp.asarray(k, CTRL_DTYPE) for k in ks]),
+                jnp.stack([jnp.asarray(x, CTRL_DTYPE) for x in xs]))
 
     def make_batch(self, start: jax.Array, batch_size: int) -> Batch:
         """Jittable: build the batch of global indices [start, start+batch_size)."""
         i = start + jnp.arange(batch_size, dtype=CTRL_DTYPE)
-        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
-        payload = jax.vmap(fn)(i)
         key = (jax.vmap(self.key_fn)(i).astype(CTRL_DTYPE) if self.key_fn
                else (i % self.num_keys if self.num_keys > 1 else jnp.zeros_like(i)))
         ts = jax.vmap(self.ts_fn)(i).astype(CTRL_DTYPE) if self.ts_fn else i
         valid = i < self.total
+        if self.is_loop:
+            C, F = batch_size, self.max_fanout
+            pay, when, ks, xs = jax.vmap(self._loop_one)(i, key, ts)
+            flat = lambda a: a.reshape((C * F,) + a.shape[2:])
+            return Batch(
+                key=flat(ks),
+                id=flat(i[:, None] * F + jnp.arange(F, dtype=CTRL_DTYPE)[None, :]),
+                ts=flat(xs),
+                payload=jax.tree.map(flat, pay),
+                valid=flat(when & valid[:, None]))
+        fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
+        payload = jax.vmap(fn)(i)
         return Batch(key=key, id=i, ts=ts, payload=payload, valid=valid)
 
     def payload_spec(self):
         i = jax.ShapeDtypeStruct((), CTRL_DTYPE)
+        if self.is_loop:
+            k = jax.ShapeDtypeStruct((), CTRL_DTYPE)
+            pay, _, _, _ = jax.eval_shape(self._loop_one, i, k, k)
+            # strip the fan-out axis
+            return jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), pay)
         fn = (lambda x: self.fn(x, self.context)) if self.is_rich else self.fn
         out = jax.eval_shape(fn, i)
         return out
@@ -146,7 +267,7 @@ class GeneratorSource(SourceBase):
     def payload_spec(self):
         return self._spec
 
-    def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
+    def _host_batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
         next_id = 0
         for item in self.it_factory():
             if isinstance(item, Batch):
@@ -160,6 +281,10 @@ class GeneratorSource(SourceBase):
             n = np.shape(jax.tree.leaves(payload)[0])[0]
             yield self._frame(payload, key, ts, n, batch_size, next_id)
             next_id += n
+
+    def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
+        for hb in self._host_batches(batch_size):
+            yield jax.device_put(hb)
 
 
 class RecordSource(SourceBase):
@@ -207,7 +332,7 @@ class RecordSource(SourceBase):
             spec[f] = jax.ShapeDtypeStruct(shape, jnp.dtype(base))
         return spec
 
-    def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
+    def _host_batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
         from ..native import unpack_records
         next_id = 0
         for rec in self.it_factory():
@@ -220,6 +345,10 @@ class RecordSource(SourceBase):
             payload = {f: cols[f] for f in self.payload_fields}
             yield self._frame(payload, key, ts, n, batch_size, next_id)
             next_id += n
+
+    def batches(self, batch_size: int = DEFAULT_BATCH_SIZE):
+        for hb in self._host_batches(batch_size):
+            yield jax.device_put(hb)
 
 
 # reference-style alias

@@ -56,22 +56,45 @@ class Win_Seq(Basic_Operator):
     routing = routing_modes_t.KEYBY
 
     def __init__(self, win_fn: Callable, spec: WindowSpec, *,
-                 incremental: bool = False, init_acc: Any = None,
+                 incremental: Optional[bool] = None, init_acc: Any = None,
                  num_keys: int = DEFAULT_MAX_KEYS, archive_capacity: int = None,
                  max_wins: int = None, tb_capacity: int = None,
                  name: str = "win_seq", parallelism: int = 1,
-                 role: role_t = role_t.SEQ):
+                 role: role_t = role_t.SEQ, context=None):
         super().__init__(name, parallelism)
         self.win_fn = win_fn
         self.spec = spec
-        self.incremental = incremental
-        self.init_acc = init_acc
-        if incremental:
+        if incremental is None:
+            # flavour deduced from the callable, like the reference's static
+            # dispatch between Iterable and winupdate signatures (wf/meta.hpp
+            # window families; catalogue /root/reference/API KEY_FARM/WIN_FARM)
+            from ..meta import classify_window_flavour
+            incremental, self.is_rich = classify_window_flavour(win_fn)
+        elif incremental:
             self.is_rich = classify_winupdate(win_fn)
-            if init_acc is None:
-                raise ValueError("incremental window function requires init_acc")
         else:
             self.is_rich = classify_window(win_fn)
+        self.incremental = incremental
+        self.init_acc = init_acc
+        if incremental and init_acc is None:
+            from ..meta import RICH_PARAM_NAMES
+            raise ValueError(
+                f"{name}: incremental window function f(wid, t, acc) -> acc "
+                f"requires init_acc. (If this callable is actually a rich "
+                f"NON-incremental f(wid, iterable, ctx), name its context "
+                f"parameter one of {RICH_PARAM_NAMES} or pass incremental=False "
+                f"— 3-positional-arg flavours are separated by the trailing "
+                f"parameter's name.)")
+        from ..context import RuntimeContext
+        self.context = context or RuntimeContext(parallelism, 0)
+        # resolve the rich flavour once: downstream code always calls self._fn
+        # with the plain arity (wf/meta.hpp rich variants bind RuntimeContext)
+        if self.is_rich and incremental:
+            self._fn = lambda w, t, a: win_fn(w, t, a, self.context)
+        elif self.is_rich:
+            self._fn = lambda w, it: win_fn(w, it, self.context)
+        else:
+            self._fn = win_fn
         self.num_keys = int(num_keys)
         self.role = role
         self._archive_capacity = archive_capacity
@@ -120,12 +143,12 @@ class Win_Seq(Basic_Operator):
         )
         wid = jax.ShapeDtypeStruct((), CTRL_DTYPE)
         if not self.incremental:
-            return jax.eval_shape(self.win_fn, wid, it)
+            return jax.eval_shape(self._fn, wid, it)
         t = TupleRef(key=wid, id=wid, ts=wid,
                      data=jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
                                        payload_spec))
         acc = jax.eval_shape(lambda: jax.tree.map(jnp.asarray, self.init_acc))
-        return jax.eval_shape(self.win_fn, wid, t, acc)
+        return jax.eval_shape(self._fn, wid, t, acc)
 
     # ------------------------------------------------------------------ insert
 
@@ -260,9 +283,9 @@ class Win_Seq(Basic_Operator):
         it = Iterable(data=jax.tree.map(self._wsc, data), ids=self._wsc(ids),
                       ts=self._wsc(tss), mask=self._wsc(content_mask))
         if self.incremental:
-            results = _fold_windows(self.win_fn, wid, it, self.init_acc)
+            results = _fold_windows(self._fn, wid, it, self.init_acc)
         else:
-            results = jax.vmap(self.win_fn)(wid, it)
+            results = jax.vmap(self._fn)(wid, it)
 
         out = Batch(key=k_safe, id=wid,
                     ts=self._wsc(res_ts if s.is_cb

@@ -108,17 +108,22 @@ class Pipeline:
     (SURVEY §7 step 3); MultiPipe builds on this per-segment."""
 
     def __init__(self, source: SourceBase, ops: Sequence[Basic_Operator],
-                 sink: Optional[Sink] = None, *, batch_size: int = DEFAULT_BATCH_SIZE):
+                 sink: Optional[Sink] = None, *, batch_size: int = DEFAULT_BATCH_SIZE,
+                 prefetch: int = 0):
         self.source = source
         self.sink = sink
         self.batch_size = batch_size
+        self.prefetch = int(prefetch)   # >0: overlapped host framing + H2D transfers
         chain_ops = list(ops)
+        cap = getattr(source, "out_capacity", lambda b: b)(batch_size)
         self.chain = CompiledChain(chain_ops, source.payload_spec(),
-                                   batch_capacity=batch_size)
+                                   batch_capacity=cap)
 
     def run(self):
         stats = self.source.get_StatsRecords()[0]
-        for batch in self.source.batches(self.batch_size):
+        batches = (self.source.batches_prefetched(self.batch_size, self.prefetch)
+                   if self.prefetch else self.source.batches(self.batch_size))
+        for batch in batches:
             out = self.chain.push(batch)
             stats.record_launch()
             if self.sink is not None:

@@ -42,7 +42,7 @@ class ThreadedPipeline:
         self.pin = pin
         spec = source.payload_spec()
         self.chains: List[CompiledChain] = []
-        cap = batch_size
+        cap = getattr(source, "out_capacity", lambda b: b)(batch_size)
         for seg in segments:
             chain = CompiledChain(list(seg), spec, batch_capacity=cap)
             spec = chain.out_spec
