@@ -1,0 +1,209 @@
+"""Merge/split semantic parity with the reference.
+
+Legality (wf/pipegraph.hpp:2992-3026 entry checks; :813-965 structural cases):
+illegal topologies must raise; the reference merge_test/split_test DAG shapes
+(src/merge_test/test_merge_{1..4}.cpp, src/split_test/test_split_{1..5}.cpp)
+must match dense oracles at multiple batch sizes under both drivers."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.runtime.pipegraph import PipeGraph
+
+
+def src(total=90, mod=7, name="s"):
+    return wf.Source(lambda i: {"v": (i % mod).astype(jnp.float32)}, total=total,
+                     num_keys=2, name=name)
+
+
+def collector(acc):
+    def cb(view):
+        if view is None:
+            return
+        p = view["payload"]
+        leaf = p["v"] if isinstance(p, dict) else p
+        acc.extend(np.asarray(leaf).tolist())
+    return wf.Sink(cb)
+
+
+# ---------------- legality rejections ------------------------------------------
+
+def test_merge_self_rejected():
+    g = PipeGraph()
+    a = g.add_source(src())
+    with pytest.raises(RuntimeError, match="merged with itself"):
+        a.merge(a)
+
+
+def test_merge_foreign_pipe_rejected():
+    g1, g2 = PipeGraph(), PipeGraph()
+    a = g1.add_source(src())
+    b = g2.add_source(src())
+    with pytest.raises(RuntimeError, match="does not belong"):
+        a.merge(b)
+
+
+def test_merge_already_merged_rejected():
+    g = PipeGraph()
+    a, b, c = (g.add_source(src(name=n)) for n in "abc")
+    a.merge(b)
+    with pytest.raises(RuntimeError, match="already been merged"):
+        a.merge(c)
+
+
+def test_merge_split_pipe_rejected():
+    g = PipeGraph()
+    a = g.add_source(src()).split(lambda t: t.v % 2 == 0, 2)
+    b = g.add_source(src(name="b"))
+    with pytest.raises(RuntimeError, match="split MultiPipe cannot be merged"):
+        a.merge(b)
+
+
+def test_merge_sunk_pipe_rejected():
+    g = PipeGraph()
+    a = g.add_source(src()).add_sink(collector([]))
+    b = g.add_source(src(name="b"))
+    with pytest.raises(RuntimeError, match="sink"):
+        b.merge(a)
+
+
+def test_merge_noncontiguous_siblings_rejected():
+    g = PipeGraph()
+    s = g.add_source(src()).split(lambda t: jnp.int32(t.v) % 3, 3)
+    with pytest.raises(RuntimeError, match="contiguous"):
+        s.select(0).merge(s.select(2))
+
+
+def test_merge_mixed_root_and_branch_rejected():
+    g = PipeGraph()
+    s = g.add_source(src()).split(lambda t: jnp.int32(t.v) % 2, 2)
+    b = g.add_source(src(name="b"))
+    with pytest.raises(RuntimeError, match="not supported"):
+        s.select(0).merge(b)
+
+
+def test_merge_branches_of_different_splits_rejected():
+    g = PipeGraph()
+    s1 = g.add_source(src(name="s1")).split(lambda t: jnp.int32(t.v) % 2, 2)
+    s2 = g.add_source(src(name="s2")).split(lambda t: jnp.int32(t.v) % 2, 2)
+    with pytest.raises(RuntimeError, match="different split parents"):
+        s1.select(0).merge(s2.select(0))
+
+
+def test_merge_contiguous_siblings_legal():
+    g = PipeGraph()
+    s = g.add_source(src()).split(lambda t: jnp.int32(t.v) % 3, 3)
+    s.select(0).merge(s.select(1))     # contiguous: legal (merge-partial)
+
+
+def test_merge_whole_subtree_legal():
+    g = PipeGraph()
+    s = g.add_source(src()).split(lambda t: jnp.int32(t.v) % 3, 3)
+    s.select(0).merge(s.select(1), s.select(2))   # merge-full
+
+
+# ---------------- reference DAG shapes with dense oracles -----------------------
+
+def vals(total=90, mod=7):
+    return [float(i % mod) for i in range(total)]
+
+
+@pytest.mark.parametrize("batch_size,threaded", [(32, False), (77, False),
+                                                 (45, True)])
+def test_merge_three_roots_shape(batch_size, threaded):
+    """test_merge_2.cpp: three source pipelines merged into one (merge-ind)."""
+    g = PipeGraph(batch_size=batch_size)
+    a = g.add_source(src(name="a")).add(wf.Map(lambda t: {"v": t.v + 1}))
+    b = g.add_source(src(mod=5, name="b")).add(wf.Map(lambda t: {"v": t.v + 2}))
+    c = (g.add_source(src(mod=3, name="c"))
+         .add(wf.Filter(lambda t: t.v > 0))
+         .add(wf.Map(lambda t: {"v": t.v * 2})))
+    out = []
+    a.merge(b, c).add(wf.Map(lambda t: {"v": t.v * 10})).add_sink(collector(out))
+    g.run(threaded=threaded)
+    want = ([10 * (v + 1) for v in vals()] + [10 * (v + 2) for v in vals(mod=5)]
+            + [10 * (v * 2) for v in vals(mod=3) if v > 0])
+    assert sorted(out) == sorted(want)
+
+
+@pytest.mark.parametrize("batch_size,threaded", [(32, False), (60, True)])
+def test_merge_of_merged_shape(batch_size, threaded):
+    """test_merge_3/4.cpp: a merged pipe (extended by an operator) merged again
+    with a third root — merge-ind over a merged result."""
+    g = PipeGraph(batch_size=batch_size)
+    a = g.add_source(src(name="a"))
+    b = g.add_source(src(mod=5, name="b"))
+    m1 = a.merge(b).add(wf.Filter(lambda t: t.v % 2 == 0))
+    c = g.add_source(src(mod=3, name="c"))
+    out = []
+    m1.merge(c).add(wf.Map(lambda t: {"v": t.v + 100})).add_sink(collector(out))
+    g.run(threaded=threaded)
+    want = ([v + 100 for v in vals() + vals(mod=5) if v % 2 == 0]
+            + [v + 100 for v in vals(mod=3)])
+    assert sorted(out) == sorted(want)
+
+
+@pytest.mark.parametrize("batch_size,threaded", [(32, False), (45, False),
+                                                 (60, True)])
+def test_split_then_partial_merge_shape(batch_size, threaded):
+    """test_split_3.cpp topology + merge-partial: split into 3 predicate
+    branches, rejoin the two contiguous ones, third sinks alone."""
+    g = PipeGraph(batch_size=batch_size)
+    s = g.add_source(src()).split(lambda t: jnp.int32(t.v) % 3, 3)
+    rejoined, solo = [], []
+    (s.select(0).merge(s.select(1))
+     .add(wf.Map(lambda t: {"v": t.v * 10})).add_sink(collector(rejoined)))
+    s.select(2).add_sink(collector(solo))
+    g.run(threaded=threaded)
+    want_rejoin = [v * 10 for v in vals() if int(v) % 3 in (0, 1)]
+    want_solo = [v for v in vals() if int(v) % 3 == 2]
+    assert sorted(rejoined) == sorted(want_rejoin)
+    assert sorted(solo) == sorted(want_solo)
+
+
+@pytest.mark.parametrize("batch_size", [32, 64])
+def test_nested_split_with_window_leaf_shape(batch_size):
+    """test_split_4/5.cpp: a nested split whose leaf is a keyed windowed
+    pattern (KF) while the sibling leaf is a plain sink and the other outer
+    branch runs a FlatMap (bool split routes False->0, True->1)."""
+    g = PipeGraph(batch_size=batch_size)
+    s0 = g.add_source(src(total=120)).split(lambda t: t.v % 2 == 0, 2)
+    # select(1): even v -> Map(+1) makes odd w in {1,3,5,7}; inner split on
+    # (w//2)%2 puts {1,5} on branch 0 and {3,7} on branch 1
+    inner = (s0.select(1).add(wf.Map(lambda t: {"v": t.v + 1}))
+             .split(lambda t: jnp.int32(t.v) // 2 % 2, 2))
+    win_out, plain_out, fm_out = [], [], []
+    (inner.select(1)
+     .add(wf.Key_FFAT(lambda t: t.v, jnp.add,
+                      spec=WindowSpec(4, 4, win_type_t.CB), num_keys=2))
+     .add_sink(collector(win_out)))
+    inner.select(0).add_sink(collector(plain_out))
+    (s0.select(0)
+     .add(wf.FlatMap(lambda t, sh: sh.push({"v": t.v * 2}), max_fanout=1))
+     .add_sink(collector(fm_out)))
+    g.run()
+
+    # oracle
+    stream = vals(120)
+    per_key = {}
+    for i, v in enumerate(stream):
+        if v % 2 == 0:
+            w = v + 1
+            if int(w) // 2 % 2 == 1:
+                per_key.setdefault(i % 2, []).append(w)
+    want_win = []
+    for k, xs in per_key.items():
+        full = len(xs) - len(xs) % 4
+        want_win.extend(sum(xs[j:j + 4]) for j in range(0, full, 4))
+        if xs[full:]:
+            want_win.append(sum(xs[full:]))   # EOS flush of the partial window
+    assert sorted(win_out) == sorted(float(x) for x in want_win) and win_out
+    want_plain = [v + 1 for v in stream if v % 2 == 0 and int(v + 1) // 2 % 2 == 0]
+    assert sorted(plain_out) == sorted(want_plain)
+    want_fm = [v * 2 for v in stream if v % 2 == 1]
+    assert sorted(fm_out) == sorted(want_fm)

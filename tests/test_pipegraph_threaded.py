@@ -71,7 +71,9 @@ def test_threaded_nested_split_and_3way_merge():
         r2 = b_rest.select(1)
         ind = g.add_source(wf.Source(lambda i: {"v": (i + 900).astype(jnp.int32)},
                                      total=12, name="sb"))
-        merged = r1.merge(r2, ind)
+        # reference-legal composition: rejoin the whole nested subtree first
+        # (merge-full), then merge the result with the independent root
+        merged = r1.merge(r2).merge(ind)
         merged.add(wf.ReduceSink(lambda t: t.v, name="m"))
         b_mul3.add(wf.ReduceSink(lambda t: t.v, name="z"))
         return {k: int(v) for k, v in g.run(threaded=threaded).items()}

@@ -131,9 +131,15 @@ class MultiPipe:
         return self.split_branches[i]
 
     def merge(self, *others: "MultiPipe") -> "MultiPipe":
-        """Merge this pipe's output with ``others`` into a new MultiPipe."""
-        self._check_open()
+        """Merge this pipe's output with ``others`` into a new MultiPipe.
+
+        Legality mirrors the reference (``wf/pipegraph.hpp:2992-3026`` entry
+        checks; structural cases merge-ind / merge-full / merge-partial with the
+        contiguity rule, ``:813-965``): at least two distinct member pipes, none
+        already merged or split or sunk, and the set must be independent roots,
+        a whole split subtree, or contiguous sibling branches."""
         pipes = [self, *others]
+        self.graph._check_merge_legality(pipes)
         specs = [p._out_payload_spec() for p in pipes]
         s0 = jax.tree.structure(specs[0])
         for s in specs[1:]:
@@ -529,6 +535,79 @@ class PipeGraph:
             else:
                 keep = jnp.asarray(sel, jnp.int32) == i
             self._push(branch, out.mask(keep))
+
+    def _leaves_under(self, mp: MultiPipe):
+        if mp.split_fn is None:
+            return [mp]
+        out = []
+        for b in mp.split_branches:
+            out.extend(self._leaves_under(b))
+        return out
+
+    def _check_merge_legality(self, pipes):
+        """The reference's merge rules (``wf/pipegraph.hpp:813-965,2992-3026``).
+
+        Entry checks: >=2 distinct pipes, all members of this graph, none already
+        merged into another pipe, split, or terminated by a sink. Structural
+        cases: merge-ind (independent roots), merge-full (a whole split subtree,
+        collapsed bottom-up like ``get_MergedNodes1``), merge-partial (siblings
+        under one split parent, CONTIGUOUS branch indexes —
+        ``get_MergedNodes2`` + the adjacency check at ``:903-910``)."""
+        if len(pipes) < 2:
+            raise RuntimeError(
+                "merge must be applied to at least two MultiPipe instances "
+                "(wf/pipegraph.hpp:2996-2999)")
+        if len({id(p) for p in pipes}) != len(pipes):
+            raise RuntimeError("a MultiPipe cannot be merged with itself "
+                               "(wf/pipegraph.hpp:3003-3008)")
+        for p in pipes:
+            if id(p) not in self._nodes:
+                raise RuntimeError("MultiPipe to be merged does not belong to "
+                                   "this PipeGraph (wf/pipegraph.hpp:673-676)")
+            if p._outputs_to:
+                raise RuntimeError("MultiPipe has already been merged "
+                                   "(application-tree leaf check, "
+                                   "wf/pipegraph.hpp:678)")
+            if p.split_fn is not None:
+                raise RuntimeError("a split MultiPipe cannot be merged — merge "
+                                   "its branches (wf/pipegraph.hpp:678)")
+            if p.has_sink:
+                raise RuntimeError("a MultiPipe with a sink has no output to "
+                                   "merge")
+        # structural classification: collapse any fully-covered split subtree to
+        # its parent, bottom-up (get_MergedNodes1's subtree-covering walk)
+        work = list(pipes)
+        changed = True
+        while changed:
+            changed = False
+            for p in work:
+                par = p._dataflow_parent
+                if par is None:
+                    continue
+                leaves = self._leaves_under(par)
+                work_ids = {id(w) for w in work}
+                if all(id(l) in work_ids for l in leaves):
+                    leaf_ids = {id(l) for l in leaves}
+                    work = [w for w in work if id(w) not in leaf_ids] + [par]
+                    changed = True
+                    break
+        if all(w._dataflow_parent is None for w in work):
+            return          # merge-ind (len>1) or merge-full (collapsed to one)
+        if any(w._dataflow_parent is None for w in work):
+            raise RuntimeError("the requested merge operation is not supported: "
+                               "mixed roots and split branches "
+                               "(wf/pipegraph.hpp:963-965)")
+        parents = {id(w._dataflow_parent) for w in work}
+        if len(parents) != 1:
+            raise RuntimeError("the requested merge operation is not supported: "
+                               "branches of different split parents "
+                               "(wf/pipegraph.hpp:963-965)")
+        par = work[0]._dataflow_parent
+        idxs = sorted(par.split_branches.index(w) for w in work)
+        if idxs != list(range(idxs[0], idxs[0] + len(idxs))):
+            raise RuntimeError("sibling MultiPipes to be merged must be "
+                               "contiguous branches of the same MultiPipe "
+                               "(wf/pipegraph.hpp:903-910)")
 
     def _exhaust(self, mp: MultiPipe):
         """A pipe's inputs are complete: flush its chain now, close its channels
