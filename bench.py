@@ -348,6 +348,46 @@ def bench_ingest():
     return steps * B / dt, dt / steps, ceiling_tps, bytes_per_tuple
 
 
+def bench_pallas_ab(shapes=((4096, 512), (1024, 1024), (8192, 256)),
+                    iters: int = 30):
+    """A/B the Pallas masked window reduce (ops/pallas_kernels.py — the
+    ComputeBatch_Kernel analogue's inner aggregation) against the XLA
+    formulation at fired-window-batch shapes [W, L]. Returns rows of
+    (W, L, xla_us, pallas_us). The winner belongs in the data path; the loser's
+    existence is only justified by this number."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from windflow_tpu.ops import pallas_kernels as pk
+
+    rows = []
+    for W, L in shapes:
+        vals = jnp.asarray(np.random.default_rng(0).random((W, L), np.float32))
+        mask = jnp.asarray(np.random.default_rng(1).random((W, L)) < 0.7)
+
+        xla = jax.jit(pk._xla_masked_sum)
+        jax.block_until_ready(xla(vals, mask))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = xla(vals, mask)
+        jax.block_until_ready(out)
+        xla_us = (time.perf_counter() - t0) / iters * 1e6
+
+        pallas_us = None
+        if pk.HAVE_PALLAS and W % pk.ROW_TILE == 0 and L % 128 == 0:
+            try:
+                jax.block_until_ready(pk.masked_window_reduce(vals, mask))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = pk.masked_window_reduce(vals, mask)
+                jax.block_until_ready(out)
+                pallas_us = (time.perf_counter() - t0) / iters * 1e6
+            except Exception as e:          # noqa: BLE001 — report, don't die
+                pallas_us = f"failed: {e}"
+        rows.append((W, L, xla_us, pallas_us))
+    return rows
+
+
 def main():
     import jax
     dev = jax.devices()[0]
@@ -395,6 +435,11 @@ def main():
             print(f"keyed scatter fan-out={n}: {sc_tps/1e6:.2f} M tuples/s "
                   f"({sc_step*1e3:.2f} ms/step)  [CUDA bar: 1.6M @2 -> "
                   f"0.2-0.7M @16]", file=sys.stderr)
+        for W, L, xla_us, pallas_us in bench_pallas_ab():
+            p = (f"{pallas_us:.1f} us" if isinstance(pallas_us, float)
+                 else str(pallas_us))
+            print(f"masked window reduce [{W},{L}]: XLA {xla_us:.1f} us vs "
+                  f"Pallas {p}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "YSB tuples/sec/chip",

@@ -34,7 +34,10 @@ def run_case(make_op, batch_size):
                            np.asarray(view["payload"]).tolist()):
             results.append((k, w, round(float(r), 3)))
 
-    wf.Pipeline(src, [make_op()], wf.Sink(cb), batch_size=batch_size).run()
+    ops = make_op()
+    if not isinstance(ops, (list, tuple)):
+        ops = [ops]
+    wf.Pipeline(src, list(ops), wf.Sink(cb), batch_size=batch_size).run()
     return sorted(results)
 
 
@@ -82,6 +85,34 @@ CASES = {
         Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
                       WindowSpec(8, 8, win_type_t.CB), map_parallelism=2,
                       num_keys=K), parallelism=2),
+    # remaining reference nesting combos (test_mp_wf+wmr_*.cpp, test_mp_kf+pf_*.cpp)
+    "nested_wf_wmr_cb": lambda: Win_Farm(
+        Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                      WindowSpec(8, 8, win_type_t.CB), map_parallelism=2,
+                      num_keys=K), parallelism=2),
+    "nested_kf_pf_cb": lambda: Key_Farm(
+        Pane_Farm(lambda pid, it: it.sum("v"), lambda wid, it: it.sum(),
+                  WindowSpec(9, 3, win_type_t.CB), num_keys=K), parallelism=2),
+    "nested_wf_pf_tb": lambda: Win_Farm(
+        Pane_Farm(lambda pid, it: it.sum("v"), lambda wid, it: it.sum(),
+                  WindowSpec(12, 4, win_type_t.TB), num_keys=K), parallelism=2),
+    # chaining variants (test_mp_*_chaining.cpp): stateless ops fused ahead of
+    # the windowed pattern — one compiled program, same results
+    "kf_cb_chaining": lambda: [wf.Map(lambda t: {"v": t.v + 1.0}),
+                               wf.Filter(lambda t: t.v > 2.0),
+                               Key_Farm(lambda wid, it: it.max("v"),
+                                        WindowSpec(6, 3, win_type_t.CB),
+                                        parallelism=3, num_keys=K)],
+    "pf_tb_chaining": lambda: [wf.Map(lambda t: {"v": t.v * 2.0}),
+                               Pane_Farm(lambda pid, it: it.sum("v"),
+                                         lambda wid, it: it.sum(),
+                                         WindowSpec(12, 4, win_type_t.TB),
+                                         num_keys=K)],
+    "wmr_cb_chaining": lambda: [wf.Filter(lambda t: t.v % 2 == 0),
+                                Win_MapReduce(lambda wid, it: it.sum("v"),
+                                              lambda wid, it: it.sum(),
+                                              WindowSpec(8, 8, win_type_t.CB),
+                                              map_parallelism=2, num_keys=K)],
 }
 
 
@@ -93,3 +124,40 @@ def test_result_invariance_under_geometry(case):
     assert runs[0], f"{case}: produced no windows"
     for r, bs in zip(runs[1:], sizes[1:]):
         assert r == runs[0], f"{case}: results differ at batch_size={bs}"
+
+
+def test_string_keyed_windows():
+    """The *_string variants (mp_common_string.hpp): non-integer keys hashed to
+    slots at ingest (hash(key) % n); window results invariant under batch size
+    and consistent per logical key."""
+    import jax
+    from windflow_tpu.operators.source import GeneratorSource
+
+    names = np.array(["alpha", "beta", "gamma"])
+
+    def run(bs):
+        def it():
+            for s in range(0, TOTAL, 60):
+                i = np.arange(s, s + 60, dtype=np.int32)
+                yield ({"v": ((i * 13) % 23).astype(np.float32)},
+                       names[i % 3], i)
+        src = GeneratorSource(it, {"v": jax.ShapeDtypeStruct((), jnp.float32)},
+                              num_keys=8)
+        results = []
+
+        def cb(view):
+            if view is None:
+                return
+            results.extend((int(k), int(w), round(float(r), 3))
+                           for k, w, r in zip(view["key"].tolist(),
+                                              view["id"].tolist(),
+                                              np.asarray(view["payload"]).tolist()))
+        wf.Pipeline(src, [Key_FFAT(lambda t: t.v, jnp.add,
+                                   spec=WindowSpec(8, 4, win_type_t.CB),
+                                   num_keys=8)],
+                    wf.Sink(cb), batch_size=bs).run()
+        return sorted(results)
+
+    a, b = run(60), run(120)
+    assert a == b and a
+    assert len({k for k, _, _ in a}) == 3       # three logical keys, hashed slots
