@@ -60,10 +60,17 @@ def shard_batch(batch: Batch, mesh: Mesh, axis: str = "dp") -> Batch:
 
 class ShardedChain:
     """Wraps a :class:`CompiledChain`, placing its states on the mesh so every
-    ``push``/``flush`` runs as one GSPMD-partitioned program."""
+    ``push``/``flush`` runs as one GSPMD-partitioned program.
+
+    On a 1-D mesh, ``axis`` carries both the batch capacity axis and the state
+    shard axis. On a 2-D mesh (``make_mesh_2d``), pass ``key_axis`` (and/or
+    ``win_axis``) to place key tables / fired-window rows on a different mesh
+    axis than the batch: batch over ``dp`` (operator replication), key state
+    over ``key`` (KF whole-key routing), window rows over ``win`` (WF window
+    ownership) — the dp x ep / dp x sp layouts of the scaling playbook."""
 
     def __init__(self, chain: CompiledChain, mesh: Mesh, axis: str = "dp",
-                 win_axis: Optional[str] = None):
+                 win_axis: Optional[str] = None, key_axis: Optional[str] = None):
         self.chain = chain
         self.mesh = mesh
         self.axis = axis
@@ -73,8 +80,8 @@ class ShardedChain:
                 op.set_window_sharding(mesh, win_axis or axis)
         chain._steps = {}        # drop programs traced before shardings were set
         chain.states = [
-            jax.device_put(st, _state_sharding(op, st, mesh, axis)) if st is not None
-            else None
+            jax.device_put(st, _state_sharding(op, st, mesh, key_axis or axis))
+            if st is not None else None
             for op, st in zip(chain.ops, chain.states)]
 
     def push(self, batch: Batch) -> Batch:
