@@ -30,33 +30,61 @@ from .base import Basic_Operator
 
 class Sink(Basic_Operator):
     """Host-callback sink. The callback receives a dict with numpy ``key/id/ts``,
-    payload leaves restricted to live lanes."""
+    payload leaves restricted to live lanes.
+
+    ``async_depth > 0`` routes batches through an
+    :class:`~windflow_tpu.runtime.async_sink.AsyncResultShipper`: the
+    device->host copy starts immediately and the callback fires once the copy of
+    a batch ``async_depth`` ships old has landed — result transfer overlaps
+    device compute instead of paying a blocking round trip per batch (the
+    reference GPU D2H overlap, ``wf/win_seq_gpu.hpp:243-260,524``). Callback
+    order stays FIFO; EOS (``None``) drains everything first."""
 
     def __init__(self, fn: Callable, *, name: str = "sink", parallelism: int = 1,
-                 keyed: bool = False, context: Optional[RuntimeContext] = None):
+                 keyed: bool = False, async_depth: int = 0,
+                 context: Optional[RuntimeContext] = None):
         super().__init__(name, parallelism)
         self.fn = fn
         self.is_rich = classify_sink(fn)
         self.routing = routing_modes_t.KEYBY if keyed else routing_modes_t.FORWARD
+        self.async_depth = int(async_depth)
+        self._shipper = None
         self.context = context or RuntimeContext(parallelism, 0)
 
-    def consume(self, batch: Optional[Batch]):
-        """Host-side: deliver one batch (or None at EOS) to the user callback."""
-        if batch is None:
-            view = None
-        else:
-            host = jax.tree.map(np.asarray, batch)
-            v = host.valid
-            if not v.any():
-                return
-            view = {
-                "key": host.key[v], "id": host.id[v], "ts": host.ts[v],
-                "payload": jax.tree.map(lambda a: a[v], host.payload),
-            }
+    def _deliver(self, view):
         if self.is_rich:
             self.fn(view, self.context)
         else:
             self.fn(view)
+
+    def _deliver_host(self, host: Batch):
+        v = host.valid
+        if not v.any():
+            return
+        self._deliver({
+            "key": host.key[v], "id": host.id[v], "ts": host.ts[v],
+            "payload": jax.tree.map(lambda a: a[v], host.payload),
+        })
+
+    def consume(self, batch: Optional[Batch]):
+        """Host-side: deliver one batch (or None at EOS) to the user callback."""
+        if self.async_depth:
+            if self._shipper is None:
+                from ..runtime.async_sink import AsyncResultShipper
+                self._shipper = AsyncResultShipper(depth=self.async_depth)
+            if batch is None:
+                for rec in self._shipper.drain():
+                    self._deliver_host(rec.value)
+                self._deliver(None)
+                return
+            self._shipper.ship(batch)
+            for rec in self._shipper.harvest():
+                self._deliver_host(rec.value)
+            return
+        if batch is None:
+            self._deliver(None)
+            return
+        self._deliver_host(jax.tree.map(np.asarray, batch))
 
 
 class ReduceSink(Basic_Operator):

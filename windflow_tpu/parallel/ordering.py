@@ -84,15 +84,60 @@ class Ordering_Node:
             self._pending_chan = jnp.concatenate([self._pending_chan, chan])
         return self.try_release()
 
+    def _pad_pow2(self):
+        """Pad the pending batch to a power-of-two capacity so ``_release_jit``
+        sees O(log max-backlog) distinct shapes instead of one per concat."""
+        b, chan = self._pending, self._pending_chan
+        C = b.capacity
+        P = 1
+        while P < C:
+            P *= 2
+        if P == C:
+            return
+        pad = P - C
+
+        def pz(a):
+            return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+        self._pending = Batch(key=pz(b.key), id=pz(b.id), ts=pz(b.ts),
+                              payload=jax.tree.map(pz, b.payload),
+                              valid=pz(b.valid))
+        self._pending_chan = jnp.pad(chan, (0, pad))
+
+    def _trim_pow2(self):
+        """Compact the retained batch (live lanes first, stable) and trim its
+        capacity to the power of two covering the live count — without this the
+        padded kept capacity compounds with every concat (exponential growth);
+        with it, capacities stay pow2 and bounded by ~2x the held-back backlog."""
+        b, chan = self._pending, self._pending_chan
+        import numpy as np
+        n = int(np.asarray(jnp.sum(b.valid)))
+        cap = 1
+        while cap < max(n, 1):
+            cap *= 2
+        cap = max(cap, 64)
+        if b.capacity <= cap:
+            return
+        order = jnp.argsort(~b.valid, stable=True)    # live lanes to the front
+        sel = order[:cap]
+
+        def take(a):
+            return jnp.take(a, sel, axis=0)
+        self._pending = Batch(key=take(b.key), id=take(b.id), ts=take(b.ts),
+                              payload=jax.tree.map(take, b.payload),
+                              valid=take(b.valid))
+        self._pending_chan = jnp.take(chan, sel)
+
     def try_release(self) -> Optional[Batch]:
         """Release the prefix at or below the current low-watermark, if every
         channel has established one."""
         if self._pending is None or any(w is None for w in self._wm):
             return None
+        self._pad_pow2()
         low = min(self._wm)
         out, kept, kept_chan = self._release_jit(
             self._pending, self._pending_chan, jnp.asarray(low, CTRL_DTYPE))
         self._pending, self._pending_chan = kept, kept_chan
+        self._trim_pow2()
         return self._maybe_renumber(out)
 
     def close_channel(self, channel: int) -> Optional[Batch]:
@@ -106,6 +151,7 @@ class Ordering_Node:
         """EOS: release everything, sorted."""
         if self._pending is None:
             return None
+        self._pad_pow2()
         out, _, _ = self._release_jit(
             self._pending, self._pending_chan,
             jnp.asarray(jnp.iinfo(CTRL_DTYPE).max - 1, CTRL_DTYPE))
