@@ -1,0 +1,13 @@
+"""Smoke test of the sweep harness (tiny shapes on CPU): every workload runs,
+rows are well-formed, markdown renders."""
+
+from windflow_tpu.benchmarks.sweep import render_markdown, run_sweep
+
+
+def test_sweep_smoke():
+    rows = run_sweep(batches=(256,), keyset=(1, 16), steps=3)
+    assert len(rows) == 8
+    for name, batch, keys, tps in rows:
+        assert batch == 256 and keys in (1, 16) and tps > 0
+    md = render_markdown(rows, "cpu-test")
+    assert md.count("\n| ") == 9 and "map_stateful" in md   # header + 8 rows
