@@ -27,6 +27,9 @@ def test_num_kernels_counts_launches_not_operators():
     assert pushes == 4                      # 128 tuples / batch 32
     # the 3-op chain is ONE fused program: one kernel per push, not one per op
     assert total_kernels == pushes
+    # byte counters: 4 pushes x (key/id/ts i32 + v f32 + valid bool) x 32 lanes
+    rec = ops[0].get_StatsRecords()[0]
+    assert rec.bytes_received == 4 * 32 * (4 + 4 + 4 + 4 + 1)
 
 
 def test_win_seq_default_budget_guard():
@@ -34,6 +37,15 @@ def test_win_seq_default_budget_guard():
                  num_keys=4)
     with pytest.raises(ValueError, match="max_wins"):
         op.out_capacity(65536)              # slide=1 @ 64k batch: [64k+, 1024] gather
+
+
+def test_win_seqffat_default_budget_guard():
+    from windflow_tpu.operators.win_seqffat import Win_SeqFFAT
+    op = Win_SeqFFAT(lambda t: t.v, jnp.add,
+                     spec=WindowSpec(4096, 1, win_type_t.CB), num_keys=4,
+                     pane_capacity=8192)
+    with pytest.raises(ValueError, match="max_wins"):
+        op.out_capacity(1 << 20)
 
 
 def test_win_seq_default_budget_ok_with_explicit_max_wins():

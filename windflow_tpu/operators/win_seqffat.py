@@ -390,7 +390,16 @@ class Win_SeqFFAT(Basic_Operator):
         if self.global_time:
             # windows drainable per step, bounded by what the pane ring can hold
             return max(4, (self.P - self.wpanes) // self.spanes)
-        return max(16, -(-capacity // self.spec.slide) + 64)
+        W = max(16, -(-capacity // self.spec.slide) + 64)
+        if W * self.wpanes > (1 << 22):
+            # same adversarial-slide guard as Win_Seq._resolve_w: a window
+            # combines wpanes pane partials, so the default budget implies a
+            # [W, wpanes] gather per batch — force an explicit budget
+            raise ValueError(
+                f"{self.name}: default fired-window budget W={W} with "
+                f"{self.wpanes} panes/window implies a [{W}, {self.wpanes}] "
+                f"gather per batch; pass max_wins= to bound it")
+        return W
 
     def apply(self, state, batch: Batch):
         W = self._resolve_w(batch.capacity)

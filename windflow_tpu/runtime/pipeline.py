@@ -31,6 +31,17 @@ from ..operators.sink import ReduceSink, Sink
 from ..operators.source import SourceBase
 
 
+def _batch_nbytes(batch: Batch) -> int:
+    """Static byte size of a batch from shapes/dtypes (no device access)."""
+    total = 0
+    for leaf in jax.tree.leaves(batch):
+        size = 1
+        for d in getattr(leaf, "shape", ()):
+            size *= d
+        total += size * jax.numpy.dtype(getattr(leaf, "dtype", "float32")).itemsize
+    return total
+
+
 class CompiledChain:
     """Compile ``ops`` (no source/sink) into suffix-runnable jitted programs.
 
@@ -70,11 +81,17 @@ class CompiledChain:
         self.states = list(states)
         # batch counters are per-op; ops[from_op:] execute as ONE fused compiled
         # program, so num_kernels counts ONE launch, attributed to the entry op
-        # (reference GPU Stats_Record fields, wf/stats_record.hpp:76-80)
+        # (reference GPU Stats_Record fields, wf/stats_record.hpp:76-80).
+        # Byte counts come from static shapes (capacity x itemsize — the
+        # reference counts sizeof(tuple_t) per tuple), no device sync.
+        in_bytes = _batch_nbytes(batch)
+        out_bytes = _batch_nbytes(out)
         for j in range(from_op, len(self.ops)):
             rec = self.ops[j].get_StatsRecords()[0]
             rec.batches_received += 1
             rec.batches_sent += 1
+            rec.bytes_received += in_bytes
+            rec.bytes_sent += out_bytes
         if self.ops:
             self.ops[from_op].get_StatsRecords()[0].num_kernels += 1
         return out
