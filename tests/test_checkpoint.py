@@ -47,3 +47,40 @@ def test_checkpoint_resume_windowed(tmp_path):
     assert meta["next_batch"] == half
     outs_b = [c2.push(b) for b in batches[half:]] + c2.flush()
     assert _collect(outs_a + outs_b) == expect
+
+
+def test_checkpoint_rescale_across_meshes(tmp_path):
+    """Elastic rescaling: a pipeline checkpointed while sharded over 8 devices
+    restores onto a 4-device mesh (and vice versa) and continues bit-identically
+    — checkpoints store unsharded state; ShardedChain re-places it on load."""
+    import jax
+    from windflow_tpu.parallel import make_mesh, ShardedChain
+
+    total, K, C = 480, 8, 96
+    src = wf.Source(lambda i: {"v": (i % 11).astype(jnp.float32)},
+                    total=total, num_keys=K)
+    mk = lambda: [Key_FFAT(lambda t: t.v, jnp.add, spec=WindowSpec(16, 16),
+                           num_keys=K)]
+    batches = list(src.batches(C))
+
+    c0 = CompiledChain(mk(), src.payload_spec(), batch_capacity=C)
+    expect = _collect([c0.push(b) for b in batches] + c0.flush())
+
+    half = len(batches) // 2
+    c8 = CompiledChain(mk(), src.payload_spec(), batch_capacity=C)
+    s8 = ShardedChain(c8, make_mesh(8))
+    outs_a = [s8.push(b) for b in batches[:half]]
+    ckpt = str(tmp_path / "rescale.npz")
+    save_chain(c8, ckpt, meta={"next_batch": half})
+
+    c4 = CompiledChain(mk(), src.payload_spec(), batch_capacity=C)
+    meta = load_chain(c4, ckpt)
+    s4 = ShardedChain(c4, make_mesh(4))      # HALF the devices
+    assert meta["next_batch"] == half
+    outs_b = [s4.push(b) for b in batches[half:]] + s4.flush()
+    assert _collect(outs_a + outs_b) == expect
+
+    # key table re-placed over the 4-device mesh
+    leaves = [l for l in jax.tree.leaves(c4.states[0])
+              if getattr(l, "ndim", 0) >= 1 and l.shape[0] == K]
+    assert leaves and len({s.device for s in leaves[0].addressable_shards}) == 4
