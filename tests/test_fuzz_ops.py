@@ -1,0 +1,78 @@
+"""Randomized fuzz of the device scan/compaction/segment primitives against
+numpy oracles — counterpart of the reference's fuzz loop re-running its CUDA
+scan test at random sizes (src/individual_test_gpu/mass_cudascan_test.py:1-16).
+30 random (size, keys, fan-out, occupancy) configurations per primitive."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from windflow_tpu.ops.compaction import (exclusive_scan, compact_indices,
+                                         partition_by_destination,
+                                         scatter_compact)
+from windflow_tpu.ops.segment import segment_rank, segment_reduce
+
+RNG = np.random.default_rng(2026)
+CONFIGS = [(int(RNG.integers(1, 2049)), int(RNG.integers(1, 33)),
+            int(RNG.integers(2, 9)), float(RNG.uniform(0.05, 1.0)))
+           for _ in range(30)]
+
+
+@pytest.mark.parametrize("n,k,f,occ", CONFIGS[:10])
+def test_fuzz_exclusive_scan_and_compact(n, k, f, occ):
+    valid = RNG.random(n) < occ
+    x = valid.astype(np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(exclusive_scan(jnp.asarray(x))),
+        np.concatenate([[0], np.cumsum(x)[:-1]]))
+    idx, ovalid = compact_indices(jnp.asarray(valid))
+    count = int(np.asarray(ovalid).sum())
+    assert count == valid.sum()
+    live = np.flatnonzero(valid)
+    np.testing.assert_array_equal(np.asarray(idx)[:count], live)
+
+
+@pytest.mark.parametrize("n,k,f,occ", CONFIGS[10:20])
+def test_fuzz_partition_by_destination(n, k, f, occ):
+    valid = RNG.random(n) < occ
+    dest = RNG.integers(0, f, n).astype(np.int32)
+    cap = max(int(valid.sum()), 1)
+    gidx, ovalid = partition_by_destination(jnp.asarray(dest), jnp.asarray(valid),
+                                            f, cap)
+    gidx, ovalid = np.asarray(gidx), np.asarray(ovalid)
+    vals = np.arange(n, dtype=np.int64)
+    for d in range(f):
+        want = vals[valid & (dest == d)]
+        got = np.sort(vals[gidx[d]][ovalid[d]])
+        np.testing.assert_array_equal(got, np.sort(want))
+
+
+@pytest.mark.parametrize("n,k,f,occ", CONFIGS[20:30])
+def test_fuzz_segment_rank_and_reduce(n, k, f, occ):
+    valid = RNG.random(n) < occ
+    keys = RNG.integers(0, k, n).astype(np.int32)
+    vals = RNG.random(n).astype(np.float32)
+
+    rank = np.asarray(segment_rank(jnp.asarray(keys), jnp.asarray(valid)))
+    seen = {}
+    for i in range(n):
+        if valid[i]:
+            assert rank[i] == seen.get(keys[i], 0)
+            seen[keys[i]] = seen.get(keys[i], 0) + 1
+
+    red = np.asarray(segment_reduce(jnp.asarray(vals), jnp.asarray(keys),
+                                    jnp.asarray(valid), k))
+    want = np.zeros(k, np.float32)
+    np.add.at(want, keys[valid], vals[valid])
+    np.testing.assert_allclose(red, want, rtol=1e-5)
+
+
+def test_fuzz_scatter_compact_roundtrip():
+    for n, k, f, occ in CONFIGS[:8]:
+        valid = RNG.random(n) < occ
+        vals = RNG.integers(0, 1000, n).astype(np.int32)
+        out, ovalid = scatter_compact({"v": jnp.asarray(vals)}, jnp.asarray(valid))
+        out, ovalid = np.asarray(out["v"]), np.asarray(ovalid)
+        np.testing.assert_array_equal(out[ovalid], vals[valid])
+        assert ovalid.sum() == valid.sum()
+        assert ovalid[:int(valid.sum())].all()       # stable front-packing
