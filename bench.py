@@ -388,8 +388,31 @@ def bench_pallas_ab(shapes=((4096, 512), (1024, 1024), (8192, 256)),
     return rows
 
 
+def _device_healthcheck(timeout_s: int = 180) -> None:
+    """Fail fast (rc=2, honest stderr) when the device link is wedged instead of
+    hanging for the harness's whole timeout. Runs a tiny H2D+sync in a
+    subprocess so a hung transfer can be killed."""
+    import subprocess
+    code = ("import numpy as np, jax; "
+            "x = jax.device_put(np.random.rand(4096).astype(np.float32)); "
+            "jax.block_until_ready(x); print('ok')")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=timeout_s)
+        if proc.returncode == 0 and "ok" in proc.stdout:
+            return
+        msg = proc.stderr[-2000:]
+    except subprocess.TimeoutExpired:
+        msg = f"device probe did not finish within {timeout_s}s"
+    print(f"DEVICE UNREACHABLE: {msg}\n"
+          f"(a 4KB device_put+sync failed — the tunnel/chip is down, not the "
+          f"framework; rerun when the link recovers)", file=sys.stderr)
+    sys.exit(2)
+
+
 def main():
     import jax
+    _device_healthcheck()
     dev = jax.devices()[0]
     print(f"device: {dev}", file=sys.stderr)
 
