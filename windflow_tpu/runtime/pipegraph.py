@@ -233,6 +233,16 @@ class PipeGraph:
     def start(self):
         self._started = True
 
+    def run_supervised(self, *, checkpoint_every: int = 8,
+                       max_restarts: int = 3):
+        """Supervised execution of the whole DAG: aligned checkpoints, replay
+        from the committed positions on failure, exactly-once delivery on every
+        sink (``runtime/supervisor.py::run_graph_supervised``; the reference's
+        failure model is exit(EXIT_FAILURE), SURVEY §5)."""
+        from .supervisor import run_graph_supervised
+        return run_graph_supervised(self, checkpoint_every=checkpoint_every,
+                                    max_restarts=max_restarts)
+
     # -- threaded driver --------------------------------------------------------------
 
     def _run_threaded(self):

@@ -61,6 +61,12 @@ class CompiledChain:
         self.states = [op.init_state(self.specs[i]) for i, op in enumerate(self.ops)]
         self._steps = {}
 
+    def reset_states(self) -> None:
+        """Re-initialize every operator's state (supervised replay of a chain
+        that did not exist at the last checkpoint)."""
+        self.states = [op.init_state(self.specs[i])
+                       for i, op in enumerate(self.ops)]
+
     @property
     def out_spec(self):
         return self.specs[-1]
