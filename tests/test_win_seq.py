@@ -154,3 +154,33 @@ def test_iterable_positional_access():
         base = w * 8.0
         want = (base + 7) - base + 100.0 * (base + 1)
         assert abs(got[w] - want) < 1e-3, (w, got[w], want)
+
+
+def test_vector_payload_windows():
+    """Tuples carrying vector payloads (e.g. embeddings): windowed reduction is
+    element-wise over the trailing dims, both non-incremental and incremental."""
+    import windflow_tpu as wf
+    src = lambda: wf.Source(
+        lambda i: {"emb": (i % 5).astype(jnp.float32) * jnp.ones(4)},
+        total=96, num_keys=2)
+
+    def run(op):
+        out = []
+        def cb(view):
+            if view is None:
+                return
+            out.extend(map(tuple, np.asarray(view["payload"]).tolist()))
+        wf.Pipeline(src(), [op], wf.Sink(cb), batch_size=32).run()
+        return sorted(out)
+
+    spec = WindowSpec(8, 8, win_type_t.CB)
+    noninc = run(wf.Win_Seq(lambda wid, it: it.sum("emb"), spec, num_keys=2))
+    inc = run(wf.Win_Seq(lambda wid, t, acc: acc + t.emb, spec,
+                         init_acc=jnp.zeros(4), num_keys=2))
+    assert noninc == inc and len(noninc) == 12
+    per_key = {0: [], 1: []}
+    for i in range(96):
+        per_key[i % 2].append(float(i % 5))
+    want = sorted(tuple([sum(xs[j:j + 8])] * 4)
+                  for xs in per_key.values() for j in range(0, len(xs), 8))
+    assert noninc == want
