@@ -26,6 +26,10 @@ from .operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT, Pane_Farm,
                                      Win_MapReduce, Nested_Farm)
 from .runtime import CompiledChain, Pipeline, Stats_Record
 from .runtime.async_sink import AsyncResultShipper, ShippedResult
+from .runtime.checkpoint import save_chain, load_chain
+from .operators.source import prefetch_to_device
+from .parallel import make_mesh, make_mesh_2d
+from .parallel.sharding import ShardedChain, shard_batch
 from .runtime.pipegraph import PipeGraph, MultiPipe
 from .runtime.threaded import ThreadedPipeline
 from .runtime.supervisor import SupervisedPipeline, RestartExhausted
