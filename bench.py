@@ -458,11 +458,12 @@ def main():
             print(f"keyed scatter fan-out={n}: {sc_tps/1e6:.2f} M tuples/s "
                   f"({sc_step*1e3:.2f} ms/step)  [CUDA bar: 1.6M @2 -> "
                   f"0.2-0.7M @16]", file=sys.stderr)
-        for W, L, xla_us, pallas_us in bench_pallas_ab():
-            p = (f"{pallas_us:.1f} us" if isinstance(pallas_us, float)
-                 else str(pallas_us))
-            print(f"masked window reduce [{W},{L}]: XLA {xla_us:.1f} us vs "
-                  f"Pallas {p}", file=sys.stderr)
+
+    for W, L, xla_us, pallas_us in bench_pallas_ab():
+        p = (f"{pallas_us:.1f} us" if isinstance(pallas_us, float)
+             else str(pallas_us))
+        print(f"masked window reduce A/B [{W},{L}]: XLA {xla_us:.1f} us vs "
+              f"Pallas {p}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "YSB tuples/sec/chip",
