@@ -91,3 +91,41 @@ def test_supervised_graph_budget_exhaustion():
     inject_failures(g, fail_at=[2, 3, 4, 5, 6])     # 5 faults in one interval
     with pytest.raises(RestartExhausted):
         g.run_supervised(checkpoint_every=100, max_restarts=3)
+
+
+def test_supervised_deterministic_merge_recovers():
+    """DETERMINISTIC mode under supervision: Ordering_Node state (pending
+    held-back batches, per-channel watermarks, renumber counter) snapshots and
+    restores across injected failures; results equal the unsupervised run."""
+    def build_det(sink_cb):
+        g = PipeGraph("sup_det", batch_size=30, mode=Mode.DETERMINISTIC)
+        a = g.add_source(wf.Source(lambda i: {"v": (i % 5).astype(jnp.float32)},
+                                   total=120, num_keys=2, name="a",
+                                   ts_fn=lambda i: 2 * i))
+        b = g.add_source(wf.Source(lambda i: {"v": (i % 7).astype(jnp.float32)},
+                                   total=120, num_keys=2, name="b",
+                                   ts_fn=lambda i: 2 * i + 1))
+        (a.merge(b)
+         .add(wf.Win_Seq(lambda wid, it: it.sum("v"),
+                         WindowSpec(30, 30, win_type_t.TB, delay=60),
+                         num_keys=2))
+         .add_sink(wf.Sink(sink_cb)))
+        return g
+
+    def collect(acc):
+        def cb(view):
+            if view is None:
+                return
+            acc.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                           np.asarray(view["payload"]).tolist()))
+        return cb
+
+    plain = []
+    build_det(collect(plain)).run()
+
+    sup = []
+    g = build_det(collect(sup))
+    inject_failures(g, fail_at=[3, 7])
+    g.run_supervised(checkpoint_every=2, max_restarts=3)
+    assert g.supervised_restarts == 2
+    assert sorted(sup) == sorted(plain) and len(plain) > 0
