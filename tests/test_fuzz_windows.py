@@ -104,3 +104,72 @@ def test_tb_windows_absolute_oracle(L, S, K, batch, rate):
                 wf.Sink(cb), batch_size=batch).run()
     assert sorted(got) == py_oracle_tb(total, K, L, S, rate), \
         f"L={L} S={S} K={K} batch={batch} rate={rate}"
+
+
+OOO_CASES = []
+for _ in range(6):
+    L = int(RNG.integers(4, 20))
+    OOO_CASES.append((L, int(RNG.integers(1, L + 1)), int(RNG.integers(1, 4)),
+                      int(RNG.integers(20, 90)), int(RNG.integers(0, 12)),
+                      int(RNG.integers(1, 10))))
+
+
+def py_oracle_tb_ooo(total, K, L, S, delay, jitter, batch):
+    """Exact batch-level TB oracle with out-of-order ts + lateness: per key,
+    insert (dropping tuples below the purge horizon next_win*S), advance the
+    watermark on inserted tuples only, then fire windows with
+    hi = (wm - delay - L)//S + 1; EOS flushes windows up to wm//S + 1.
+    Mirrors Win_Seq._insert/_fired_range semantics (wf/window.hpp Triggerer_TB
+    incl. triggering_delay; OLD drops wf/win_seq.hpp:338-345)."""
+    ts_of = lambda i: max(0, i - (i * 7) % (jitter + 1))
+    arch = {k: [] for k in range(K)}
+    wm = {k: -1 for k in range(K)}
+    nw = {k: 0 for k in range(K)}
+    out = []
+
+    def fire(k, hi):
+        for w in range(nw[k], max(hi, nw[k])):
+            seg = [v for t, v in arch[k] if w * S <= t < w * S + L]
+            if seg:
+                out.append((k, w, float(sum(seg))))
+        nw[k] = max(hi, nw[k])
+
+    for s in range(0, total, batch):
+        touched = set()
+        for i in range(s, min(s + batch, total)):
+            k, t = i % K, ts_of(i)
+            if t >= nw[k] * S:                       # OLD drop below horizon
+                arch[k].append((t, float((i * 17) % 23)))
+                wm[k] = max(wm[k], t)
+            touched.add(k)
+        for k in touched:
+            fire(k, (wm[k] - delay - L) // S + 1)
+    for k in range(K):
+        if arch[k] and wm[k] >= 0:
+            fire(k, wm[k] // S + 1)
+    return sorted(out)
+
+
+@pytest.mark.parametrize("L,S,K,batch,delay,jitter", OOO_CASES)
+def test_tb_out_of_order_lateness_oracle(L, S, K, batch, delay, jitter):
+    total = 5 * max(L, batch) + 31
+    src = wf.Source(lambda i: {"v": ((i * 17) % 23).astype(jnp.float32)},
+                    total=total, num_keys=K,
+                    ts_fn=lambda i: jnp.maximum(0, i - (i * 7) % (jitter + 1)))
+    got = []
+
+    def cb(view):
+        if view is None:
+            return
+        got.extend((int(k), int(w), float(r)) for k, w, r in
+                   zip(view["key"].tolist(), view["id"].tolist(),
+                       np.asarray(view["payload"]).tolist()))
+
+    wf.Pipeline(src, [wf.Win_Seq(lambda wid, it: it.sum("v"),
+                                 WindowSpec(L, S, win_type_t.TB, delay=delay),
+                                 num_keys=K, tb_capacity=4 * total,
+                                 max_wins=512)],
+                wf.Sink(cb), batch_size=batch).run()
+    want = py_oracle_tb_ooo(total, K, L, S, delay, jitter, batch)
+    assert sorted(got) == want, \
+        f"L={L} S={S} K={K} batch={batch} delay={delay} jitter={jitter}"
