@@ -23,3 +23,19 @@ def test_masked_window_reduce_fallback_shapes():
     mask = jnp.ones((10, 7), bool)
     got = np.asarray(masked_window_reduce(vals, mask))
     np.testing.assert_allclose(got, np.full(10, 7.0))
+
+
+def test_masked_window_reduce_safe_under_enclosing_jit():
+    # Called under an enclosing trace, a Mosaic compile error would surface at
+    # the OUTER jit (past the eager try/except) and the trace-time success
+    # line would poison _pallas_ok — traced calls must route to XLA and leave
+    # the cache untouched.
+    import jax
+    from windflow_tpu.ops import pallas_kernels as pk
+
+    vals = jnp.ones((ROW_TILE * 2, 128), jnp.float32)
+    mask = jnp.ones_like(vals, bool)
+    before = dict(pk._pallas_ok)
+    got = np.asarray(jax.jit(lambda v, m: masked_window_reduce(v, m))(vals, mask))
+    np.testing.assert_allclose(got, np.full(ROW_TILE * 2, 128.0))
+    assert pk._pallas_ok == before

@@ -12,6 +12,14 @@ reference GPU engine's ``ComputeBatch_Kernel`` (one thread per window,
 ``masked_window_reduce``: given window contents ``[W, L]`` + occupancy mask, produce
 per-window sums — the hot aggregation of Win_Seq non-incremental sum windows. Falls
 back to the XLA formulation off-TPU (and under ``interpret=True`` in tests).
+
+A/B verdict (measured on TPU v5 lite, 2026-07-30, min over 5×100 async iters):
+XLA 10.1/10.9/13.3 µs vs Pallas 15.7/12.2/14.4 µs at [1024,1024]/[4096,512]/
+[8192,256]. The op reads ~8-12 MB per call — it is HBM-bandwidth-bound and XLA's
+fused where+reduce already runs at the roofline, so the data path keeps the XLA
+formulation (``Iterable.sum``) and this kernel stands as the documented negative
+result the decision rule in BASELINE.md calls for. ``bench.py::bench_pallas_ab``
+re-measures every capture; adopt if a future libtpu flips the verdict.
 """
 
 from __future__ import annotations
@@ -34,29 +42,61 @@ ROW_TILE = 256
 def _reduce_kernel(vals_ref, mask_ref, out_ref):
     v = vals_ref[...]
     m = mask_ref[...]
-    out_ref[...] = jnp.sum(jnp.where(m, v, jnp.zeros_like(v)), axis=1)
+    s = jnp.sum(jnp.where(m, v, jnp.zeros_like(v)), axis=1, keepdims=True)
+    out_ref[...] = jnp.broadcast_to(s.T, out_ref.shape)
 
 
 def _xla_masked_sum(vals, mask):
     return jnp.sum(jnp.where(mask, vals, jnp.zeros_like(vals)), axis=1)
 
 
+_xla_jit = jax.jit(_xla_masked_sum)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_masked_sum(vals, mask, *, interpret=False):
+    # The [W] result is produced as an [8, W] lane-oriented buffer: a 1-D out
+    # operand would get XLA's T(1024) linear tiling, which Mosaic's
+    # (sublane, lane) block model cannot match ("XLA layout {0:T(1024)} does
+    # not match Mosaic layout {0:T(256)}"), and a (1, T) block violates the
+    # sublane-divisible-by-8 rule. 8 sublanes × ROW_TILE lanes satisfies both;
+    # the extra 7 rows are dead writes (W*28 B — noise next to the W*L*4 read).
+    W, L = vals.shape
+    out = pl.pallas_call(
+        _reduce_kernel,
+        grid=(W // ROW_TILE,),
+        in_specs=[pl.BlockSpec((ROW_TILE, L), lambda i: (i, 0)),
+                  pl.BlockSpec((ROW_TILE, L), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, ROW_TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, W), vals.dtype),
+        interpret=interpret,
+    )(vals, mask)
+    return out[0]
+
+
+#: (W, L, interpret) -> False once Mosaic refused the shape (compile errors
+#: surface at first call, AFTER jit tracing — they cannot be caught inside the
+#: jitted body, so the XLA fallback lives out here).
+_pallas_ok: dict = {}
+
+
 def masked_window_reduce(vals: jax.Array, mask: jax.Array, *,
                          interpret: bool = False) -> jax.Array:
     """Per-window masked sum of ``vals [W, L]`` under ``mask [W, L]`` -> ``[W]``."""
     W, L = vals.shape
-    if not HAVE_PALLAS or W % ROW_TILE or L % 128:
-        return _xla_masked_sum(vals, mask)
+    key = (W, L, interpret)
+    if (not HAVE_PALLAS or W % ROW_TILE or L % 128
+            or not _pallas_ok.get(key, True)
+            # Under an enclosing trace the Mosaic compile error would surface
+            # at the OUTER jit's compile, past this try/except, and the
+            # trace-time success line would poison the cache — so traced calls
+            # take the XLA formulation (which is also the measured winner).
+            or isinstance(vals, jax.core.Tracer)):
+        return _xla_jit(vals, mask)
     try:
-        return pl.pallas_call(
-            _reduce_kernel,
-            grid=(W // ROW_TILE,),
-            in_specs=[pl.BlockSpec((ROW_TILE, L), lambda i: (i, 0)),
-                      pl.BlockSpec((ROW_TILE, L), lambda i: (i, 0))],
-            out_specs=pl.BlockSpec((ROW_TILE,), lambda i: (i,)),
-            out_shape=jax.ShapeDtypeStruct((W,), vals.dtype),
-            interpret=interpret,
-        )(vals, mask)
-    except Exception:                                  # lowering unsupported: fall back
-        return _xla_masked_sum(vals, mask)
+        out = _pallas_masked_sum(vals, mask, interpret=interpret)
+        _pallas_ok[key] = True
+        return out
+    except Exception:                                  # lowering unsupported
+        _pallas_ok[key] = False
+        return _xla_jit(vals, mask)
