@@ -376,10 +376,13 @@ def bench_pallas_ab(shapes=((4096, 512), (1024, 1024), (8192, 256)),
         pallas_us = None
         if pk.HAVE_PALLAS and W % pk.ROW_TILE == 0 and L % 128 == 0:
             try:
-                jax.block_until_ready(pk.masked_window_reduce(vals, mask))
+                # time the Pallas program itself — masked_window_reduce would
+                # silently substitute the XLA fallback on any compile failure
+                # and corrupt the A/B
+                jax.block_until_ready(pk._pallas_masked_sum(vals, mask))
                 t0 = time.perf_counter()
                 for _ in range(iters):
-                    out = pk.masked_window_reduce(vals, mask)
+                    out = pk._pallas_masked_sum(vals, mask)
                 jax.block_until_ready(out)
                 pallas_us = (time.perf_counter() - t0) / iters * 1e6
             except Exception as e:          # noqa: BLE001 — report, don't die

@@ -207,3 +207,30 @@ def test_nested_split_with_window_leaf_shape(batch_size):
     assert sorted(plain_out) == sorted(want_plain)
     want_fm = [v * 2 for v in stream if v % 2 == 1]
     assert sorted(fm_out) == sorted(want_fm)
+
+
+@pytest.mark.parametrize("threaded", [False, True])
+def test_partial_merge_chain_absorbs_merged_sibling(threaded):
+    """4-branch split; merge(b0,b1) is merge-partial; merging that RESULT with
+    b2 is still partial (covers {0,1,2} — the absorbed sibling is itself a
+    merged pipe, not a split branch); the app tree must track the replacement
+    so the final sink composition runs. Dense oracle under both drivers."""
+    g = PipeGraph(batch_size=32)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=200))
+    mp.split(lambda t: (t.v % 4).astype(jnp.int32), 4)
+    def mk(m):
+        return wf.Map(lambda t: {"v": t.v * m})
+    b = [mp.select(i).chain(mk(10 ** i)) for i in range(4)]
+    m01 = b[0].merge(b[1])
+    m012 = m01.merge(b[2])
+    assert m01._merge_parent is mp and m01._covers_idx == (0, 1)
+    assert m012._merge_parent is mp and m012._covers_idx == (0, 1, 2)
+    # app tree: children of mp's node are now [m012's leaf, b3's leaf]
+    node = g._node_of(mp)
+    assert [c.mp for c in node.children] == [m012, b[3]]
+    m012.add(wf.ReduceSink(lambda t: t.v, name="m"))
+    b[3].add(wf.ReduceSink(lambda t: t.v, name="r3"))
+    res = {k: int(v) for k, v in g.run(threaded=threaded).items()}
+    expect_m = sum(v * 10 ** (v % 4) for v in range(200) if v % 4 < 3)
+    assert res["m"] == expect_m
+    assert res["r3"] == sum(v * 1000 for v in range(200) if v % 4 == 3)

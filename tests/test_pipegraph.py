@@ -226,3 +226,86 @@ def test_closing_function_runs_per_replica_at_teardown():
         ReduceSink_Builder(lambda t: t.v).withName("out").build())
     g.run()
     assert sorted(calls) == [(0, 3), (1, 3), (2, 3)]
+
+
+def test_split_branches_recombined_then_extended():
+    """graph_2 shape: S->M, split 2 (branch 0: F->M, branch 1: F), merge the
+    two branches back, M, sink."""
+    total = 200
+    g = PipeGraph("g2", batch_size=64)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total))
+    mp.chain(wf.Map(lambda t: {"v": t.v + 1}))              # v in 1..total
+    mp.split(lambda t: (t.v % 2).astype(jnp.int32), 2)
+    b0 = (mp.select(0).chain(wf.Filter(lambda t: t.v % 3 != 0))
+          .chain(wf.Map(lambda t: {"v": t.v * 10})))
+    b1 = mp.select(1).chain(wf.Filter(lambda t: t.v % 5 != 0))
+    merged = b0.merge(b1)
+    merged.chain(wf.Map(lambda t: {"v": t.v + 7}))
+    merged.add(wf.ReduceSink(lambda t: t.v, name="out"))
+    res = g.run()
+    evens = [v * 10 for v in range(1, total + 1) if v % 2 == 0 and v % 3 != 0]
+    odds = [v for v in range(1, total + 1) if v % 2 == 1 and v % 5 != 0]
+    assert int(res["out"]) == sum(v + 7 for v in evens + odds)
+
+
+def test_merged_branches_merged_again_with_sibling():
+    """graph_8 shape: S->M, MULTICAST split 3 ({0} | {1} | {1,2}), each branch
+    F->M; merge(branch1, branch0) (sibling order swapped), two chained maps,
+    then merge the merged pipe with the remaining sibling branch 2, sink."""
+    total = 240
+    g = PipeGraph("g8", batch_size=48)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total))
+    mp.chain(wf.Map(lambda t: {"v": t.v + 1}))              # v in 1..total
+    mp.split(lambda t: jnp.stack([t.v % 2 == 1,             # odd  -> {0}
+                                  t.v % 2 == 0,             # even -> {1} (+2 below)
+                                  (t.v % 2 == 0) & (t.v % 3 != 0)]), 3)
+    b0 = (mp.select(0).chain(wf.Filter(lambda t: t.v % 5 != 0))
+          .chain(wf.Map(lambda t: {"v": t.v * 10})))
+    b1 = (mp.select(1).chain(wf.Filter(lambda t: t.v % 7 != 0))
+          .chain(wf.Map(lambda t: {"v": t.v * 100})))
+    b2 = (mp.select(2).chain(wf.Filter(lambda t: t.v > 20))
+          .chain(wf.Map(lambda t: {"v": t.v + 3})))
+    m01 = b1.merge(b0)
+    m01.chain(wf.Map(lambda t: {"v": t.v + 1}))
+    m01.chain(wf.Map(lambda t: {"v": t.v + 2}))
+    final = m01.merge(b2)
+    final.add(wf.ReduceSink(lambda t: t.v, name="out"))
+    res = g.run()
+    vs = range(1, total + 1)
+    path0 = [v * 10 for v in vs if v % 2 == 1 and v % 5 != 0]
+    path1 = [v * 100 for v in vs if v % 2 == 0 and v % 7 != 0]
+    path2 = [v + 3 for v in vs if v % 2 == 0 and v % 3 != 0 and v > 20]
+    assert int(res["out"]) == sum(v + 3 for v in path0 + path1) + sum(path2)
+
+
+def test_cross_level_merge_with_sunk_sibling():
+    """graph_9 shape: S->M, split 3; branch 2 ends in its OWN sink; branch 1
+    splits again into two map leaves; merge(branch0, leaf0, leaf1) — a
+    cross-level merge where the nested split's whole subtree collapses into
+    branch 1, leaving contiguous siblings — then sink."""
+    total = 300
+    g = PipeGraph("g9", batch_size=60)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=total))
+    mp.chain(wf.Map(lambda t: {"v": t.v + 1}))              # v in 1..total
+    mp.split(lambda t: jnp.where(t.v % 2 == 1, 0,
+                                 jnp.where(t.v % 3 == 0, 1, 2)).astype(jnp.int32), 3)
+    b0 = (mp.select(0).chain(wf.Filter(lambda t: t.v % 5 != 0))
+          .chain(wf.Map(lambda t: {"v": t.v * 10})))
+    b1 = (mp.select(1).chain(wf.Filter(lambda t: t.v > 6))
+          .chain(wf.Map(lambda t: {"v": t.v + 100})))
+    b2 = mp.select(2).chain(wf.Filter(lambda t: t.v < 50))
+    b2.add(wf.ReduceSink(lambda t: t.v, name="solo"))
+    b1.split(lambda t: (t.v % 4 >= 2).astype(jnp.int32), 2)
+    leaf0 = b1.select(0).chain(wf.Map(lambda t: {"v": t.v * 2}))
+    leaf1 = b1.select(1).chain(wf.Map(lambda t: {"v": t.v * 3}))
+    final = b0.merge(leaf0, leaf1)
+    final.chain(wf.Map(lambda t: {"v": t.v + 1}))
+    final.add(wf.ReduceSink(lambda t: t.v, name="out"))
+    res = g.run()
+    vs = range(1, total + 1)
+    path0 = [v * 10 for v in vs if v % 2 == 1 and v % 5 != 0]
+    b1_vals = [v + 100 for v in vs if v % 2 == 0 and v % 3 == 0 and v > 6]
+    leaves = [v * 2 if v % 4 < 2 else v * 3 for v in b1_vals]
+    path2 = [v for v in vs if v % 2 == 0 and v % 3 != 0 and v < 50]
+    assert int(res["solo"]) == sum(path2)
+    assert int(res["out"]) == sum(v + 1 for v in path0 + leaves)

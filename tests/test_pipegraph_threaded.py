@@ -59,26 +59,56 @@ def test_threaded_windowed_pipeline():
 
 
 def test_threaded_nested_split_and_3way_merge():
-    """Threaded driver on the deeper graph_test shapes: nested split + 3-way merge."""
+    """Threaded driver on the deeper graph_test shapes: nested split, 3-way
+    merge covering the WHOLE outer split subtree (merge-full collapses it to
+    the root pipe), then merge with an independent root (merge-ind)."""
     def build(threaded):
         g = PipeGraph("tg", batch_size=64)
         mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=240,
                                     name="sa"))
         mp.split(lambda t: (t.v % 3 == 0).astype(jnp.int32), 2)
         b_rest, b_mul3 = mp.select(0), mp.select(1)
+        b_mul3.add(wf.Map(lambda t: {"v": t.v * 1000}, name="mz"))
         b_rest.split(lambda t: (t.v % 3 - 1).astype(jnp.int32), 2)
         r1 = b_rest.select(0)
-        r2 = b_rest.select(1)
+        r2 = b_rest.select(1).add(wf.Map(lambda t: {"v": t.v * 10}, name="m2"))
         ind = g.add_source(wf.Source(lambda i: {"v": (i + 900).astype(jnp.int32)},
                                      total=12, name="sb"))
-        # reference-legal composition: rejoin the whole nested subtree first
-        # (merge-full), then merge the result with the independent root
-        merged = r1.merge(r2).merge(ind)
+        merged = r1.merge(r2, b_mul3).merge(ind)
         merged.add(wf.ReduceSink(lambda t: t.v, name="m"))
-        b_mul3.add(wf.ReduceSink(lambda t: t.v, name="z"))
         return {k: int(v) for k, v in g.run(threaded=threaded).items()}
 
     seq, thr = build(False), build(True)
     assert seq == thr
-    assert seq["z"] == sum(i for i in range(240) if i % 3 == 0)
-    assert seq["m"] == sum(i for i in range(240) if i % 3) + sum(range(900, 912))
+    expect = (sum(i * 1000 for i in range(240) if i % 3 == 0)
+              + sum(i for i in range(240) if i % 3 == 1)
+              + sum(i * 10 for i in range(240) if i % 3 == 2)
+              + sum(range(900, 912)))
+    assert seq["m"] == expect
+
+
+def test_nested_subtree_merge_stays_a_branch():
+    """Merge-full of a NESTED subtree re-parents the merged pipe as a branch of
+    the outer split (wf/pipegraph.hpp:822-846 Case 2.1), so merging it with an
+    independent root must be rejected (get_MergedNodes2 LCA=root,
+    wf/pipegraph.hpp:763-765) — while extending it with operators and a sink
+    stays legal."""
+    import pytest
+    g = PipeGraph("tg2", batch_size=64)
+    mp = g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=240,
+                                name="sa"))
+    mp.split(lambda t: (t.v % 3 == 0).astype(jnp.int32), 2)
+    b_rest, b_mul3 = mp.select(0), mp.select(1)
+    b_rest.split(lambda t: (t.v % 3 - 1).astype(jnp.int32), 2)
+    merged = b_rest.select(0).merge(b_rest.select(1))
+    ind = g.add_source(wf.Source(lambda i: {"v": (i + 900).astype(jnp.int32)},
+                                 total=12, name="sb"))
+    with pytest.raises(RuntimeError, match="not supported"):
+        merged.merge(ind)
+    ind.add(wf.ReduceSink(lambda t: t.v, name="i"))
+    merged.add(wf.ReduceSink(lambda t: t.v, name="m"))
+    b_mul3.add(wf.ReduceSink(lambda t: t.v, name="z"))
+    res = g.run()
+    assert int(res["z"]) == sum(i for i in range(240) if i % 3 == 0)
+    assert int(res["m"]) == sum(i for i in range(240) if i % 3)
+    assert int(res["i"]) == sum(range(900, 912))

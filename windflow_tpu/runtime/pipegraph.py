@@ -71,6 +71,13 @@ class MultiPipe:
         self._chain: Optional[CompiledChain] = None
         self._outputs_to: List[MultiPipe] = []
         self._ordering = None     # lazily-built Ordering_Node (DETERMINISTIC merges)
+        # application-tree position of a PARTIAL merge result: the reference
+        # re-parents the merged AppNode under the split parent, replacing the
+        # absorbed sibling branches (wf/pipegraph.hpp:944-952) — that is what
+        # legalizes graph_8/graph_9-style follow-up merges with the remaining
+        # siblings.
+        self._merge_parent: Optional[MultiPipe] = None
+        self._covers_idx: tuple = ()
 
     # -- construction (reference add/chain overloads, wf/pipegraph.hpp:1565-2950) -----
 
@@ -139,7 +146,7 @@ class MultiPipe:
         already merged or split or sunk, and the set must be independent roots,
         a whole split subtree, or contiguous sibling branches."""
         pipes = [self, *others]
-        self.graph._check_merge_legality(pipes)
+        merge_parent, covers_idx = self.graph._check_merge_legality(pipes)
         specs = [p._out_payload_spec() for p in pipes]
         s0 = jax.tree.structure(specs[0])
         for s in specs[1:]:
@@ -150,11 +157,37 @@ class MultiPipe:
                                 "(wf/pipegraph.hpp:1573-1578 typeid check)")
         merged = MultiPipe(self.graph)
         merged.merge_inputs = pipes
+        merged._merge_parent = merge_parent
+        merged._covers_idx = covers_idx
+        # Application-Tree surgery, as the reference does it: the merged node is
+        # a LEAF that replaces the absorbed subtrees — under the split parent
+        # for merge-partial / nested merge-full (wf/pipegraph.hpp:846-858,
+        # 944-957), as a root for merge-ind / root-level merge-full.
         node = AppNode(merged)
+        if merge_parent is not None:
+            parent_node = self.graph._node_of(merge_parent)
+            node.parent = parent_node
+            # a direct child is absorbed iff the branch indexes it covers are
+            # within this merge's cover (children are split branches, or the
+            # results of earlier partial merges which are NOT in
+            # split_branches — identify both by index cover)
+            def _child_idxs(c):
+                if c.mp._merge_parent is merge_parent:
+                    return set(c.mp._covers_idx)
+                return {i for i, b in enumerate(merge_parent.split_branches)
+                        if b is c.mp}
+            target = set(covers_idx)
+            new_children, replaced = [], False
+            for c in parent_node.children:
+                ci = _child_idxs(c)
+                if ci and ci <= target:
+                    if not replaced:
+                        new_children.append(node)
+                        replaced = True
+                else:
+                    new_children.append(c)
+            parent_node.children = new_children
         for p in pipes:
-            pn = self.graph._node_of(p)
-            node.children.append(pn)
-            pn.parent = node
             p._outputs_to.append(merged)
         self.graph._nodes[id(merged)] = node
         self.graph._merged_roots = [r for r in self.graph._merged_roots
@@ -546,14 +579,6 @@ class PipeGraph:
                 keep = jnp.asarray(sel, jnp.int32) == i
             self._push(branch, out.mask(keep))
 
-    def _leaves_under(self, mp: MultiPipe):
-        if mp.split_fn is None:
-            return [mp]
-        out = []
-        for b in mp.split_branches:
-            out.extend(self._leaves_under(b))
-        return out
-
     def _check_merge_legality(self, pipes):
         """The reference's merge rules (``wf/pipegraph.hpp:813-965,2992-3026``).
 
@@ -584,40 +609,61 @@ class PipeGraph:
             if p.has_sink:
                 raise RuntimeError("a MultiPipe with a sink has no output to "
                                    "merge")
-        # structural classification: collapse any fully-covered split subtree to
-        # its parent, bottom-up (get_MergedNodes1's subtree-covering walk)
+        # Structural classification over the APPLICATION tree (not the dataflow
+        # graph): each work item covers a set of branch indexes under its
+        # app-tree parent — a split branch covers its own index; a partial-merge
+        # result covers the indexes of the branches it absorbed (the reference
+        # re-parents the merged AppNode under the split parent,
+        # wf/pipegraph.hpp:944-952). Collapse bottom-up: whenever items under
+        # one parent cover ALL its branches, they become that parent
+        # (get_MergedNodes1's subtree-covering walk).
+        def cover_of(p):
+            """(app-tree parent, covered branch-index set) — (None, None) = root."""
+            if p._merge_parent is not None:
+                return p._merge_parent, set(p._covers_idx)
+            par = p._dataflow_parent
+            if par is None:
+                return None, None
+            return par, {next(i for i, b in enumerate(par.split_branches)
+                              if b is p)}
+
         work = list(pipes)
         changed = True
         while changed:
             changed = False
+            by_parent: dict = {}
             for p in work:
-                par = p._dataflow_parent
-                if par is None:
-                    continue
-                leaves = self._leaves_under(par)
-                work_ids = {id(w) for w in work}
-                if all(id(l) in work_ids for l in leaves):
-                    leaf_ids = {id(l) for l in leaves}
-                    work = [w for w in work if id(w) not in leaf_ids] + [par]
+                par, idxs = cover_of(p)
+                if par is not None:
+                    key = id(par)
+                    by_parent.setdefault(key, (par, []))[1].append((p, idxs))
+            for par, items in by_parent.values():
+                covered = set().union(*(i for _, i in items))
+                if covered == set(range(len(par.split_branches))):
+                    drop = {id(p) for p, _ in items}
+                    work = [w for w in work if id(w) not in drop] + [par]
                     changed = True
                     break
-        if all(w._dataflow_parent is None for w in work):
-            return          # merge-ind (len>1) or merge-full (collapsed to one)
-        if any(w._dataflow_parent is None for w in work):
+        covers = [cover_of(w) for w in work]
+        if all(par is None for par, _ in covers):
+            # merge-ind (len>1) or merge-full (collapsed to one root)
+            return None, ()
+        if any(par is None for par, _ in covers):
             raise RuntimeError("the requested merge operation is not supported: "
                                "mixed roots and split branches "
                                "(wf/pipegraph.hpp:963-965)")
-        parents = {id(w._dataflow_parent) for w in work}
-        if len(parents) != 1:
+        if len({id(par) for par, _ in covers}) != 1:
             raise RuntimeError("the requested merge operation is not supported: "
                                "branches of different split parents "
                                "(wf/pipegraph.hpp:963-965)")
-        par = work[0]._dataflow_parent
-        idxs = sorted(par.split_branches.index(w) for w in work)
+        par = covers[0][0]
+        idxs = sorted(set().union(*(i for _, i in covers)))
         if idxs != list(range(idxs[0], idxs[0] + len(idxs))):
             raise RuntimeError("sibling MultiPipes to be merged must be "
                                "contiguous branches of the same MultiPipe "
                                "(wf/pipegraph.hpp:903-910)")
+        # merge-partial: the result pipe takes this position in the app tree
+        return par, tuple(idxs)
 
     def _exhaust(self, mp: MultiPipe):
         """A pipe's inputs are complete: flush its chain now, close its channels
