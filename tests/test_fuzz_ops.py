@@ -76,3 +76,36 @@ def test_fuzz_scatter_compact_roundtrip():
         np.testing.assert_array_equal(out[ovalid], vals[valid])
         assert ovalid.sum() == valid.sum()
         assert ovalid[:int(valid.sum())].all()       # stable front-packing
+
+
+@pytest.mark.parametrize("n,k,f,occ", CONFIGS[10:20])
+def test_fuzz_partition_onehot_matches_sort(n, k, f, occ):
+    """The sort-free one-hot partition must agree with the sort-based one
+    exactly — same stable within-destination order, same validity — including
+    under capacity truncation."""
+    from windflow_tpu.ops.compaction import partition_by_destination_onehot
+    valid = RNG.random(n) < occ
+    dest = RNG.integers(0, f, n).astype(np.int32)
+    for cap in (max(int(valid.sum()), 1), max(int(valid.sum()) // (2 * f), 1)):
+        a_idx, a_val = partition_by_destination(jnp.asarray(dest),
+                                                jnp.asarray(valid), f, cap)
+        b_idx, b_val = partition_by_destination_onehot(jnp.asarray(dest),
+                                                       jnp.asarray(valid), f, cap)
+        np.testing.assert_array_equal(np.asarray(a_val), np.asarray(b_val))
+        np.testing.assert_array_equal(
+            np.asarray(a_idx)[np.asarray(a_val)],
+            np.asarray(b_idx)[np.asarray(b_val)])
+
+
+def test_partition_onehot_drops_out_of_range_like_sort():
+    """A routing_func may return dest outside [0, n_dest); both variants must
+    DROP such lanes (sort maps them to the discarded n_dest bucket) rather
+    than overwrite a legitimate lane's slot."""
+    from windflow_tpu.ops.compaction import partition_by_destination_onehot
+    dest = jnp.asarray(np.array([2, 5, 0, -1, 2, 1], np.int32))
+    valid = jnp.ones(6, bool)
+    a_idx, a_val = partition_by_destination(dest, valid, 3, 2)
+    b_idx, b_val = partition_by_destination_onehot(dest, valid, 3, 2)
+    np.testing.assert_array_equal(np.asarray(a_val), np.asarray(b_val))
+    np.testing.assert_array_equal(np.asarray(a_idx)[np.asarray(a_val)],
+                                  np.asarray(b_idx)[np.asarray(b_val)])

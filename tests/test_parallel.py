@@ -113,3 +113,16 @@ def test_ordering_node_ts_merge():
         o = jax.tree.map(np.asarray, tail)
         released.extend(o.ts[o.valid].tolist())
     assert released == sorted(released) == [1, 2, 4, 5, 7, 9]
+
+
+def test_standard_emitter_partition_variants_agree():
+    """The sort-based and one-hot KEYBY partitions must route identically
+    (same sub-batch membership AND stable within-destination order)."""
+    b = _batches(96, 96, 8)[0]
+    outs_s = Standard_Emitter(4, routing_modes_t.KEYBY, partition="sort").route(b)
+    outs_o = Standard_Emitter(4, routing_modes_t.KEYBY, partition="onehot").route(b)
+    for os_, oo in zip(outs_s, outs_o):
+        os_, oo = jax.tree.map(np.asarray, os_), jax.tree.map(np.asarray, oo)
+        assert (os_.valid == oo.valid).all()
+        assert (os_.id[os_.valid] == oo.id[oo.valid]).all()
+        assert (os_.key[os_.valid] == oo.key[oo.valid]).all()

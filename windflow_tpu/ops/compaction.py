@@ -55,7 +55,10 @@ def partition_by_destination(dest: jax.Array, valid: jax.Array, n_dest: int,
     ``[n_dest, cap]``). The device-side counterpart of the GPU keyed-scatter emitter
     building per-destination sub-batches (``wf/standard_nodes_gpu.hpp:60-238``)."""
     c = dest.shape[0]
-    key = jnp.where(valid, dest, n_dest)
+    # out-of-range destinations (a user routing_func may return anything,
+    # including negatives, which would sort BEFORE bucket 0 and shift every
+    # offset) are dropped via the discarded n_dest bucket
+    key = jnp.where(valid & (dest >= 0) & (dest < n_dest), dest, n_dest)
     order = jnp.argsort(key, stable=True)          # lanes grouped by destination
     sorted_key = jnp.take(key, order)
     # per-destination counts and offsets
@@ -67,3 +70,35 @@ def partition_by_destination(dest: jax.Array, valid: jax.Array, n_dest: int,
     out_valid = lane[None, :] < counts[:, None]
     gather_idx = jnp.clip(gather_idx, 0, c - 1)
     return jnp.take(order, gather_idx), out_valid
+
+
+def partition_by_destination_onehot(dest: jax.Array, valid: jax.Array,
+                                    n_dest: int, capacity_per_dest: int):
+    """Sort-free variant of :func:`partition_by_destination` for SMALL fan-out:
+    each lane's within-destination rank comes from a one-hot cumsum ([C, D]
+    sequential-memory traffic instead of the sort network's log^2 passes), then
+    one scatter builds the [n_dest, cap] gather table. Same contract as the
+    sort-based form. This is the framework's V1-vs-sort counterpart of the
+    reference's scattering study (``src/GPU_Tests/scattering``); ``bench.py``
+    A/Bs the two and the emitter keeps the sort as default until the on-chip
+    number says otherwise."""
+    c = dest.shape[0]
+    cap = capacity_per_dest
+    # out-of-range destinations are dropped, exactly like the sort variant
+    # (which maps them to the discarded n_dest bucket)
+    valid = valid & (dest >= 0) & (dest < n_dest)
+    oh = ((dest[:, None] == jnp.arange(n_dest, dtype=dest.dtype)[None, :])
+          & valid[:, None])
+    ranks = jnp.cumsum(oh.astype(jnp.int32), axis=0)        # [C, D] inclusive
+    rank = jnp.take_along_axis(ranks, jnp.clip(dest, 0, n_dest - 1)[:, None],
+                               axis=1)[:, 0] - 1            # within-dest position
+    counts = ranks[-1]
+    tgt = jnp.where(valid & (rank < cap),
+                    jnp.clip(dest, 0, n_dest - 1) * cap + rank,
+                    n_dest * cap)                           # OOB -> dropped
+    gather_idx = (jnp.zeros((n_dest * cap,), jnp.int32)
+                  .at[tgt].set(jnp.arange(c, dtype=jnp.int32), mode="drop")
+                  .reshape(n_dest, cap))
+    lane = jnp.arange(cap, dtype=jnp.int32)
+    out_valid = lane[None, :] < jnp.minimum(counts, cap)[:, None]
+    return gather_idx, out_valid

@@ -49,17 +49,25 @@ class Basic_Emitter:
 
 class Standard_Emitter(Basic_Emitter):
     def __init__(self, n_dest: int, mode: routing_modes_t = routing_modes_t.FORWARD,
-                 routing_func: Callable = None, capacity_per_dest: int = None):
+                 routing_func: Callable = None, capacity_per_dest: int = None,
+                 partition: str = "sort"):
         super().__init__(n_dest)
         self.mode = mode
         self.routing_func = routing_func or (lambda h, n: h % n)
         self.capacity_per_dest = capacity_per_dest
+        # "sort" (stable argsort grouping) or "onehot" (sort-free cumsum ranks) —
+        # the two formulations of the reference's scattering study
+        # (src/GPU_Tests/scattering); bench.py A/Bs them per fan-out
+        self.partition = partition
         self._rr = 0
         self._jit_part = jax.jit(self._partition, static_argnums=(1,))
 
     def _partition(self, batch: Batch, cap: int):
+        from ..ops.compaction import partition_by_destination_onehot
+        part = (partition_by_destination_onehot if self.partition == "onehot"
+                else partition_by_destination)
         dest = self.routing_func(batch.key, self.n_dest).astype(jnp.int32)
-        idx, ov = partition_by_destination(dest, batch.valid, self.n_dest, cap)
+        idx, ov = part(dest, batch.valid, self.n_dest, cap)
         return [batch.select(idx[d], ov[d]) for d in range(self.n_dest)]
 
     def route(self, batch: Batch) -> List[Optional[Batch]]:
