@@ -113,6 +113,54 @@ CASES = {
                                               lambda wid, it: it.sum(),
                                               WindowSpec(8, 8, win_type_t.CB),
                                               map_parallelism=2, num_keys=K)],
+    # remaining nested TB combos (test_mp_kf+pf_tb.cpp, test_mp_kf+wmr_tb.cpp,
+    # test_mp_wf+wmr_tb.cpp)
+    "nested_kf_pf_tb": lambda: Key_Farm(
+        Pane_Farm(lambda pid, it: it.sum("v"), lambda wid, it: it.sum(),
+                  WindowSpec(12, 4, win_type_t.TB), num_keys=K), parallelism=2),
+    "nested_kf_wmr_tb": lambda: Key_Farm(
+        Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                      WindowSpec(12, 12, win_type_t.TB), map_parallelism=2,
+                      num_keys=K), parallelism=2),
+    "nested_wf_wmr_tb": lambda: Win_Farm(
+        Win_MapReduce(lambda wid, it: it.sum("v"), lambda wid, it: it.sum(),
+                      WindowSpec(12, 12, win_type_t.TB), map_parallelism=3,
+                      num_keys=K), parallelism=2),
+    # remaining chaining combos (test_mp_wf_cb_chaining.cpp, kf_tb_chaining,
+    # pf_cb_chaining, wmr_tb_chaining)
+    "wf_cb_chaining": lambda: [wf.Map(lambda t: {"v": t.v + 0.5}),
+                               Win_Farm(lambda wid, it: it.sum("v"),
+                                        WindowSpec(10, 5, win_type_t.CB),
+                                        parallelism=4, num_keys=K)],
+    "kf_tb_chaining": lambda: [wf.Filter(lambda t: t.v != 3.0),
+                               Key_Farm(lambda wid, it: it.max("v"),
+                                        WindowSpec(10, 5, win_type_t.TB),
+                                        parallelism=3, num_keys=K)],
+    "pf_cb_chaining": lambda: [wf.Map(lambda t: {"v": t.v * 3.0}),
+                               Pane_Farm(lambda pid, it: it.sum("v"),
+                                         lambda wid, it: it.sum(),
+                                         WindowSpec(9, 3, win_type_t.CB),
+                                         num_keys=K)],
+    "wmr_tb_chaining": lambda: [wf.Filter(lambda t: t.v > 1.0),
+                                Win_MapReduce(lambda wid, it: it.sum("v"),
+                                              lambda wid, it: it.sum(),
+                                              WindowSpec(12, 12, win_type_t.TB),
+                                              map_parallelism=2, num_keys=K)],
+    # _2 geometry variants (the reference's *_tb_2 files re-run with a second
+    # window/slide pair)
+    "win_seq_tb_2": lambda: Win_Seq(lambda wid, it: it.sum("v"),
+                                    WindowSpec(20, 4, win_type_t.TB), num_keys=K),
+    "key_farm_tb_2": lambda: Key_Farm(lambda wid, it: it.max("v"),
+                                      WindowSpec(15, 5, win_type_t.TB),
+                                      parallelism=3, num_keys=K),
+    "pane_farm_tb_2": lambda: Pane_Farm(lambda pid, it: it.sum("v"),
+                                        lambda wid, it: it.sum(),
+                                        WindowSpec(16, 4, win_type_t.TB),
+                                        num_keys=K),
+    "wmr_tb_2": lambda: Win_MapReduce(lambda wid, it: it.sum("v"),
+                                      lambda wid, it: it.sum(),
+                                      WindowSpec(18, 18, win_type_t.TB),
+                                      map_parallelism=3, num_keys=K),
 }
 
 
@@ -126,10 +174,31 @@ def test_result_invariance_under_geometry(case):
         assert r == runs[0], f"{case}: results differ at batch_size={bs}"
 
 
-def test_string_keyed_windows():
-    """The *_string variants (mp_common_string.hpp): non-integer keys hashed to
-    slots at ingest (hash(key) % n); window results invariant under batch size
-    and consistent per logical key."""
+STRING_OPS = {
+    "kf_ffat": lambda: Key_FFAT(lambda t: t.v, jnp.add,
+                                spec=WindowSpec(8, 4, win_type_t.CB), num_keys=8),
+    "key_farm": lambda: Key_Farm(lambda wid, it: it.max("v"),
+                                 WindowSpec(6, 3, win_type_t.CB),
+                                 parallelism=3, num_keys=8),
+    "win_farm": lambda: Win_Farm(lambda wid, it: it.sum("v"),
+                                 WindowSpec(10, 5, win_type_t.CB),
+                                 parallelism=4, num_keys=8),
+    "pane_farm": lambda: Pane_Farm(lambda pid, it: it.sum("v"),
+                                   lambda wid, it: it.sum(),
+                                   WindowSpec(9, 3, win_type_t.CB), num_keys=8),
+    "wmr": lambda: Win_MapReduce(lambda wid, it: it.sum("v"),
+                                 lambda wid, it: it.sum(),
+                                 WindowSpec(8, 8, win_type_t.CB),
+                                 map_parallelism=2, num_keys=8),
+}
+
+
+@pytest.mark.parametrize("op_name", sorted(STRING_OPS))
+def test_string_keyed_windows(op_name):
+    """The *_string variants (mp_common_string.hpp: kf/pf/wf/wmr over
+    string-keyed tuples): non-integer keys hashed to slots at ingest
+    (hash(key) % n); window results invariant under batch size and consistent
+    per logical key."""
     import jax
     from windflow_tpu.operators.source import GeneratorSource
 
@@ -152,9 +221,7 @@ def test_string_keyed_windows():
                            for k, w, r in zip(view["key"].tolist(),
                                               view["id"].tolist(),
                                               np.asarray(view["payload"]).tolist()))
-        wf.Pipeline(src, [Key_FFAT(lambda t: t.v, jnp.add,
-                                   spec=WindowSpec(8, 4, win_type_t.CB),
-                                   num_keys=8)],
+        wf.Pipeline(src, [STRING_OPS[op_name]()],
                     wf.Sink(cb), batch_size=bs).run()
         return sorted(results)
 
