@@ -95,3 +95,20 @@ def test_keyed_map_fast_path_ok_without_duplicates():
               valid=jnp.ones(3, bool))
     _, out = jax.jit(op.apply)(st, b)
     np.testing.assert_allclose(np.asarray(out.payload["v"]), [1.0, 1.0, 1.0])
+
+
+def test_xprof_trace_produces_a_capture(tmp_path):
+    """wf.xprof_trace wraps a run in a JAX profiler capture (SURVEY §5 tracing:
+    Xprof hooks beside the Stats_Record counters)."""
+    import os
+    import jax.numpy as jnp
+    import windflow_tpu as wf
+
+    logdir = str(tmp_path / "trace")
+    with wf.xprof_trace(logdir):
+        g = wf.PipeGraph("prof", batch_size=32)
+        g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=64)) \
+         .add(wf.ReduceSink(lambda t: t.v, name="s"))
+        g.run()
+    found = [os.path.join(r, f) for r, _, fs in os.walk(logdir) for f in fs]
+    assert found, "profiler produced no capture files"

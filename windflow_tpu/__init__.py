@@ -25,6 +25,7 @@ from .operators.win_seqffat import Win_SeqFFAT
 from .operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT, Pane_Farm,
                                      Win_MapReduce, Nested_Farm)
 from .runtime import CompiledChain, Pipeline, Stats_Record
+from .stats import xprof_trace
 from .runtime.async_sink import AsyncResultShipper, ShippedResult
 from .runtime.checkpoint import save_chain, load_chain
 from .operators.source import prefetch_to_device

@@ -11,6 +11,7 @@ Always on (cheap), dumped via ``dump_to_file`` like ``dump_toFile``
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -81,3 +82,23 @@ class Stats_Record:
         with open(path, "w") as f:
             json.dump(self.as_dict(), f, indent=2)
         return path
+
+
+@contextlib.contextmanager
+def xprof_trace(logdir: str):
+    """JAX profiler capture around a pipeline run — the Xprof half of the
+    reference's tracing story (``TRACE_WINDFLOW`` counters are the other half;
+    SURVEY §5). Produces a TensorBoard-loadable trace under ``logdir``::
+
+        with wf.xprof_trace("/tmp/trace"):
+            graph.run()
+
+    Works on CPU and TPU backends; on TPU the trace includes per-HLO device
+    timing, H2D/D2H transfers, and fusion boundaries — the ground truth behind
+    the cost table in docs/ARCHITECTURE.md §5."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
