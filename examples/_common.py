@@ -1,0 +1,23 @@
+"""Shared example bootstrap: repo-root import path + backend selection."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def select_backend(virtual_devices: int = 0) -> None:
+    """``WF_CPU=1`` forces the CPU backend (config-update form — the env-var
+    form is overridden by preloaded TPU plugins and can hang on a wedged device
+    link); anything else uses the session's accelerator. When forcing CPU,
+    ``virtual_devices`` requests an N-device virtual mesh."""
+    if os.environ.get("WF_CPU", "") in ("", "0"):
+        return
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={virtual_devices}"
+            ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")

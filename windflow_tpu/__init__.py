@@ -16,7 +16,7 @@ from .context import RuntimeContext, LocalStorage
 from .shipper import Shipper
 from .operators import (Basic_Operator, Source, DeviceSource, GeneratorSource,
                         RecordSource,
-                        Map, KeyedMap, Filter, FilterMap, Compact, FlatMap,
+                        Map, KeyedMap, KeyBy, Filter, FilterMap, Compact, FlatMap,
                         Accumulator, Sink, ReduceSink)
 from .operators.map import BatchMap
 from .operators.window import WindowSpec, Iterable

@@ -47,8 +47,6 @@ def make_ops(num_keys: int = N_CAMPAIGNS, win_len: int = WIN_LEN,
     # ad -> campaign: static fixture table (campaign_generator.hpp analogue)
     camp_of = jnp.asarray(np.arange(N_ADS) // ADS_PER_CAMPAIGN, CTRL_DTYPE)
 
-    from ..operators.base import Basic_Operator
-
     from ..operators.map import BatchMap
     from ..ops.lookup import table_lookup
 
@@ -58,13 +56,10 @@ def make_ops(num_keys: int = N_CAMPAIGNS, win_len: int = WIN_LEN,
     join = BatchMap(lambda p: {"cmp": table_lookup(camp_of, p["ad_id"])},
                     name="ysb_join")
 
-    # Key routing: the window op keys on campaign id; re-key the batch in a tiny
-    # projection op that rewrites the control key field (KEYBY re-route).
-    class _Rekey(Basic_Operator):
-        def apply(self, state, batch):
-            return state, batch.replace(key=batch.payload["cmp"])
-
-    rekey = _Rekey("ysb_rekey")
+    # Key routing: the window op keys on campaign id (KEYBY re-route on a
+    # payload field)
+    from ..operators.map import KeyBy
+    rekey = KeyBy(lambda t: t.cmp, num_keys, name="ysb_rekey")
     window = Key_FFAT(lambda t: jnp.ones((), jnp.int32), jnp.add,
                       spec=WindowSpec(win_len, win_len, win_type_t.TB),
                       num_keys=num_keys, name="ysb_window",

@@ -1,6 +1,6 @@
 from .base import Basic_Operator
 from .source import Source, DeviceSource, GeneratorSource, RecordSource, SourceBase
-from .map import Map, KeyedMap
+from .map import Map, KeyedMap, KeyBy
 from .filter import Filter, FilterMap, Compact
 from .flatmap import FlatMap
 from .accumulator import Accumulator
@@ -8,6 +8,6 @@ from .sink import Sink, ReduceSink
 
 __all__ = [
     "Basic_Operator", "Source", "DeviceSource", "GeneratorSource", "RecordSource", "SourceBase",
-    "Map", "KeyedMap", "Filter", "FilterMap", "Compact", "FlatMap",
+    "Map", "KeyedMap", "KeyBy", "Filter", "FilterMap", "Compact", "FlatMap",
     "Accumulator", "Sink", "ReduceSink",
 ]

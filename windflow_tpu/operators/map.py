@@ -70,6 +70,35 @@ class Map(Basic_Operator):
         return state, batch.with_payload(payload)
 
 
+class KeyBy(Basic_Operator):
+    """Re-key the stream: ``key = fn(t) % num_keys`` rewrites the batch's key
+    control field.
+
+    The reference re-keys by writing the key control field in user code
+    (``setControlFields``, ``src/graph_test/graph_common.hpp:69-80``) and then
+    routing KEYBY on ``std::hash(key) % n`` (``wf/standard_emitter.hpp:88-99``).
+    Here the control fields live in the Batch, so re-keying is its own tiny
+    operator that fuses to nothing; every keyed operator downstream
+    (Accumulator, Key_Farm, Key_FFAT, KeyedMap...) routes on the new key.
+    ``fn`` takes a :class:`TupleRef`; rich variant takes ``(t, ctx)``."""
+
+    def __init__(self, fn: Callable, num_keys: int, *, name: str = "keyby",
+                 parallelism: int = 1, context: Optional[RuntimeContext] = None):
+        super().__init__(name, parallelism)
+        self.fn = fn
+        self.num_keys = int(num_keys)
+        self.is_rich = classify_map(fn)
+        self.routing = routing_modes_t.KEYBY
+        self.context = context or RuntimeContext(parallelism, 0)
+
+    def apply(self, state, batch: Batch):
+        def one(t):
+            k = (self.fn(t, self.context) if self.is_rich else self.fn(t))
+            return k
+        key = jax.vmap(one)(tuple_refs(batch)).astype(batch.key.dtype)
+        return state, batch.replace(key=key % self.num_keys)
+
+
 class BatchMap(Basic_Operator):
     """Batch-level map: ``fn(payload_pytree_of_[C,...]) -> payload_pytree`` — for
     transforms best expressed over whole arrays (joins via table lookups, projections,
