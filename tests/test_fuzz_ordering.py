@@ -1,0 +1,129 @@
+"""Randomized interleaving fuzz for Ordering_Node against an exact host oracle.
+
+The reference's guarantee (wf/ordering_node.hpp:79-94): whatever the
+interleaving of per-channel deliveries, the released stream is the global
+(ts, id)-sorted merge, each tuple exactly once, and no tuple is released
+before the low-watermark proves nothing smaller can still arrive. Channels
+are internally ordered (the reference's standing assumption); batch sizes,
+delivery interleavings, gaps, and per-channel rates are all randomized."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from windflow_tpu.basic import ordering_mode_t
+from windflow_tpu.batch import Batch
+from windflow_tpu.parallel.ordering import Ordering_Node
+
+RNG = np.random.default_rng(42)
+
+
+def make_batch(keys, ids, ts, vals):
+    n = len(ids)
+    return Batch(key=jnp.asarray(keys, jnp.int32), id=jnp.asarray(ids, jnp.int32),
+                 ts=jnp.asarray(ts, jnp.int32),
+                 payload={"v": jnp.asarray(vals, jnp.float32)},
+                 valid=jnp.ones(n, bool))
+
+
+def drain(out, acc):
+    if out is None:
+        return
+    v = np.asarray(out.valid)
+    acc.extend(zip(np.asarray(out.ts)[v].tolist(), np.asarray(out.id)[v].tolist(),
+                   np.asarray(out.payload["v"])[v].tolist()))
+
+
+@pytest.mark.parametrize("trial", range(8))
+def test_fuzz_interleaved_channels_release_global_sorted_merge(trial):
+    rng = np.random.default_rng(100 + trial)
+    n_ch = int(rng.integers(2, 5))
+    # per-channel streams: sorted ts with random gaps/duplicates; globally unique ids
+    streams, uid = [], 0
+    for c in range(n_ch):
+        n = int(rng.integers(5, 60))
+        ts = np.cumsum(rng.integers(0, 4, n)).astype(np.int32)  # non-decreasing
+        ids = np.arange(uid, uid + n, dtype=np.int32)
+        uid += n
+        streams.append([(int(t), int(i)) for t, i in zip(ts, ids)])
+
+    node = Ordering_Node(n_ch, ordering_mode_t.TS)
+    released = []
+    cursors = [0] * n_ch
+    while any(cursors[c] < len(streams[c]) for c in range(n_ch)):
+        c = int(rng.integers(0, n_ch))
+        if cursors[c] >= len(streams[c]):
+            continue
+        take = int(rng.integers(1, 9))
+        chunk = streams[c][cursors[c]:cursors[c] + take]
+        cursors[c] += take
+        ts = [t for t, _ in chunk]
+        ids = [i for _, i in chunk]
+        # released prefix must never exceed the provable low-watermark
+        out = node.push(c, make_batch([0] * len(ids), ids, ts, ids))
+        before = len(released)
+        drain(out, released)
+        wms = [w for w in node._wm if w is not None]
+        if len(wms) == node.n_inputs and len(released) > before:
+            low = min(wms)
+            assert all(t <= low for t, _, _ in released[before:])
+    for c in range(n_ch):
+        drain(node.close_channel(c), released)
+    drain(node.flush(), released)
+
+    everything = [(t, i, float(i)) for s in streams for t, i in s]
+    # exact oracle: stable global sort by (ts, id)
+    assert released == sorted(everything, key=lambda x: (x[0], x[1]))
+
+
+@pytest.mark.parametrize("mode", [ordering_mode_t.ID, ordering_mode_t.TS_RENUMBERING])
+def test_fuzz_other_modes(mode):
+    rng = np.random.default_rng(7)
+    n_ch = 3
+    streams, uid = [], 0
+    for c in range(n_ch):
+        n = int(rng.integers(10, 40))
+        ts = np.cumsum(rng.integers(0, 3, n)).astype(np.int32)
+        ids = np.arange(uid, uid + n, dtype=np.int32)
+        uid += n
+        streams.append([(int(t), int(i)) for t, i in zip(ts, ids)])
+    node = Ordering_Node(n_ch, mode)
+    released = []
+    cursors = [0] * n_ch
+    while any(cursors[c] < len(streams[c]) for c in range(n_ch)):
+        c = int(rng.integers(0, n_ch))
+        if cursors[c] >= len(streams[c]):
+            continue
+        take = int(rng.integers(1, 6))
+        chunk = streams[c][cursors[c]:cursors[c] + take]
+        cursors[c] += take
+        drain(node.push(c, make_batch([0] * len(chunk),
+                                      [i for _, i in chunk],
+                                      [t for t, _ in chunk],
+                                      [i for _, i in chunk])), released)
+    for c in range(n_ch):
+        drain(node.close_channel(c), released)
+    drain(node.flush(), released)
+    everything = [(t, i, float(i)) for s in streams for t, i in s]
+    if mode == ordering_mode_t.ID:
+        # ID mode: global sort by id (each channel's ids ascend)
+        assert [i for _, i, _ in released] == sorted(i for _, i, _ in everything)
+    else:
+        # TS_RENUMBERING: ts-sorted payload order + progressive released ids
+        assert [v for _, _, v in released] == [
+            v for _, _, v in sorted(everything, key=lambda x: (x[0], x[1]))]
+        assert [i for _, i, _ in released] == list(range(len(everything)))
+
+
+def test_flush_releases_max_sentinel_ts():
+    """EOS must release tuples whose ts sits at the dtype maximum: the close/
+    flush sentinel is the full max, and the strict-< TS release must not drop
+    them (review-caught regression of the tie fix)."""
+    top = int(np.iinfo(np.int32).max)
+    node = Ordering_Node(2, ordering_mode_t.TS)
+    released = []
+    drain(node.push(0, make_batch([0, 0], [1, 2], [5, top - 1], [1.0, 2.0])), released)
+    drain(node.close_channel(1), released)
+    drain(node.close_channel(0), released)
+    drain(node.flush(), released)
+    assert [i for _, i, _ in released] == [1, 2]

@@ -7,8 +7,13 @@ buffers tuples per key in priority queues and releases those at or below the
 
 - each input channel advances a watermark = max (ts or id) of the batches it has
   delivered;
-- buffered batches are merged, stably sorted by (ts, id) (or (id,)), and the prefix
-  with sort-key <= min(channel watermarks) is released; the rest is retained.
+- buffered batches are merged, stably sorted by (ts, id) (or (id,)), and the
+  provably-complete prefix is released, the rest retained. ID mode releases
+  sort-key <= min(channel watermarks) like the reference (a channel's ids
+  strictly increase, so watermark ties cannot recur); TS modes release strictly
+  BELOW the low watermark — a channel may deliver more tuples EQUAL to its own
+  watermark, and releasing those ties early would leak poll interleaving into
+  the output order. Channel EOS lifts that channel's gate entirely.
 
 Modes mirror ``ordering_mode_t`` (``wf/basic.hpp:129``): ID, TS, TS_RENUMBERING
 (released tuples are renumbered with a progressive id — used by DETERMINISTIC
@@ -59,7 +64,16 @@ class Ordering_Node:
         chan_s = jnp.take(chan, order)
         ks = jnp.where(sortedb.valid,
                        self._sort_keys(sortedb, chan_s)[0], big)
-        releasable = ks <= low_wm
+        # ID mode: a channel's ids strictly increase, so ties AT the watermark
+        # cannot arrive again — release `<=` like the reference
+        # (wf/ordering_node.hpp:197 `id > min_id` break). TS modes: a channel
+        # may deliver MORE tuples equal to its own watermark, so releasing ties
+        # at the low watermark would leak poll interleaving into the output
+        # order (fuzz-caught); hold them until every watermark strictly passes.
+        if self.mode == ordering_mode_t.ID:
+            releasable = ks <= low_wm
+        else:
+            releasable = ks < low_wm
         out = sortedb.mask(releasable)
         kept = sortedb.mask(sortedb.valid & ~releasable)
         return out, kept, chan_s
@@ -143,8 +157,10 @@ class Ordering_Node:
     def close_channel(self, channel: int) -> Optional[Batch]:
         """Channel EOS: it no longer gates the low-watermark (the reference drops
         the channel from ``maxs[]`` when its EOS marker arrives). Returns any batch
-        that the advanced watermark releases."""
-        self._wm[channel] = int(jnp.iinfo(CTRL_DTYPE).max - 1)
+        that the advanced watermark releases. The sentinel is the full dtype max
+        so that once EVERY channel is closed, the strict-`<` TS release frees
+        even tuples at the maximum representable ts instead of dropping them."""
+        self._wm[channel] = int(jnp.iinfo(CTRL_DTYPE).max)
         return self.try_release()
 
     def flush(self) -> Optional[Batch]:
@@ -152,9 +168,11 @@ class Ordering_Node:
         if self._pending is None:
             return None
         self._pad_pow2()
+        # low = dtype max: `ks < low` (TS) and `ks <= low` (ID) both release every
+        # valid lane (invalid lanes carry sort-key == max and stay masked out)
         out, _, _ = self._release_jit(
             self._pending, self._pending_chan,
-            jnp.asarray(jnp.iinfo(CTRL_DTYPE).max - 1, CTRL_DTYPE))
+            jnp.asarray(jnp.iinfo(CTRL_DTYPE).max, CTRL_DTYPE))
         self._pending, self._pending_chan = None, None
         return self._maybe_renumber(out)
 
