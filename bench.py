@@ -477,11 +477,11 @@ def main():
           f"batch={BATCH})", file=sys.stderr)
     print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "
           f"({sl_step_s*1e3:.2f} ms/step)", file=sys.stderr)
+    kc_tps, kc_step = _run_isolated("bench_keyed_cb()")
+    print(f"keyed CB sliding windows (K=512, w=1024 s=512): "
+          f"{kc_tps/1e6:.2f} M tuples/s ({kc_step*1e3:.2f} ms/step)",
+          file=sys.stderr)
     if os.environ.get("WF_BENCH_ALL"):
-        kc_tps, kc_step = _run_isolated("bench_keyed_cb()")
-        print(f"keyed CB sliding windows (K=512, w=1024 s=512): "
-              f"{kc_tps/1e6:.2f} M tuples/s ({kc_step*1e3:.2f} ms/step)",
-              file=sys.stderr)
         for k in (1, 500, 10000):
             ks_tps, ks_step = _run_isolated(f"bench_keyed_stateful({k})")
             print(f"keyed-stateful map (K={k}): {ks_tps/1e6:.2f} M tuples/s "

@@ -15,9 +15,6 @@ from windflow_tpu.basic import ordering_mode_t
 from windflow_tpu.batch import Batch
 from windflow_tpu.parallel.ordering import Ordering_Node
 
-RNG = np.random.default_rng(42)
-
-
 def make_batch(keys, ids, ts, vals):
     n = len(ids)
     return Batch(key=jnp.asarray(keys, jnp.int32), id=jnp.asarray(ids, jnp.int32),
@@ -116,13 +113,14 @@ def test_fuzz_other_modes(mode):
 
 
 def test_flush_releases_max_sentinel_ts():
-    """EOS must release tuples whose ts sits at the dtype maximum: the close/
-    flush sentinel is the full max, and the strict-< TS release must not drop
-    them (review-caught regression of the tie fix)."""
+    """EOS must release tuples whose ts sits AT the dtype maximum: mid-stream
+    that value is indistinguishable from the invalid-lane sentinel, so flush
+    releases valid lanes unconditionally instead of via a watermark compare
+    (review-caught data-loss regression of the tie fix)."""
     top = int(np.iinfo(np.int32).max)
     node = Ordering_Node(2, ordering_mode_t.TS)
     released = []
-    drain(node.push(0, make_batch([0, 0], [1, 2], [5, top - 1], [1.0, 2.0])), released)
+    drain(node.push(0, make_batch([0, 0], [1, 2], [5, top], [1.0, 2.0])), released)
     drain(node.close_channel(1), released)
     drain(node.close_channel(0), released)
     drain(node.flush(), released)

@@ -41,7 +41,7 @@ class Ordering_Node:
         self._pending: Optional[Batch] = None
         self._pending_chan = None                # i32[C] source channel per lane
         self._next_id = 0
-        self._release_jit = jax.jit(self._release)
+        self._release_jit = jax.jit(self._release, static_argnums=(3,))
 
     # -- jitted core ------------------------------------------------------------------
 
@@ -54,7 +54,7 @@ class Ordering_Node:
         sec = b.ts if self.mode == ordering_mode_t.ID else b.id
         return prim, sec, chan
 
-    def _release(self, pending: Batch, chan, low_wm):
+    def _release(self, pending: Batch, chan, low_wm, release_all=False):
         big = jnp.iinfo(CTRL_DTYPE).max
         prim, sec, tert = self._sort_keys(pending, chan)
         primv = jnp.where(pending.valid, prim, big)
@@ -62,6 +62,14 @@ class Ordering_Node:
         order = jnp.lexsort((tert, sec, primv))
         sortedb = pending.select(order, jnp.ones_like(pending.valid))
         chan_s = jnp.take(chan, order)
+        if release_all:
+            # EOS: every valid lane goes, sorted. No watermark compare — a
+            # valid sort-key equal to the dtype max is indistinguishable from
+            # the invalid-lane sentinel in `ks`, so any threshold would either
+            # drop it or resurrect dead lanes.
+            out = sortedb
+            kept = sortedb.mask(jnp.zeros_like(sortedb.valid))
+            return out, kept, chan_s
         ks = jnp.where(sortedb.valid,
                        self._sort_keys(sortedb, chan_s)[0], big)
         # ID mode: a channel's ids strictly increase, so ties AT the watermark
@@ -157,9 +165,11 @@ class Ordering_Node:
     def close_channel(self, channel: int) -> Optional[Batch]:
         """Channel EOS: it no longer gates the low-watermark (the reference drops
         the channel from ``maxs[]`` when its EOS marker arrives). Returns any batch
-        that the advanced watermark releases. The sentinel is the full dtype max
-        so that once EVERY channel is closed, the strict-`<` TS release frees
-        even tuples at the maximum representable ts instead of dropping them."""
+        that the advanced watermark releases. The sentinel is the full dtype
+        max, which un-gates the channel for everything below the max; a valid
+        tuple AT the dtype max rides out with ``flush`` (whose release is
+        unconditional on valid lanes) — mid-stream it is indistinguishable
+        from the invalid-lane sentinel, so no watermark can free it."""
         self._wm[channel] = int(jnp.iinfo(CTRL_DTYPE).max)
         return self.try_release()
 
@@ -168,11 +178,9 @@ class Ordering_Node:
         if self._pending is None:
             return None
         self._pad_pow2()
-        # low = dtype max: `ks < low` (TS) and `ks <= low` (ID) both release every
-        # valid lane (invalid lanes carry sort-key == max and stay masked out)
         out, _, _ = self._release_jit(
             self._pending, self._pending_chan,
-            jnp.asarray(jnp.iinfo(CTRL_DTYPE).max, CTRL_DTYPE))
+            jnp.asarray(jnp.iinfo(CTRL_DTYPE).max, CTRL_DTYPE), True)
         self._pending, self._pending_chan = None, None
         return self._maybe_renumber(out)
 
