@@ -171,3 +171,37 @@ def test_nested_kf_wmr_builder():
     op = KeyFarm_Builder(inner).withParallelism(2).build()
     spec = WindowSpec(*spec_args, win_type_t.CB)
     assert collect(150, 2, op) == winseq_oracle(150, 2, spec)
+
+
+def test_fuzz_patterns_match_win_seq_random_geometry():
+    """Randomized specs x patterns vs the Win_Seq oracle: every parallel
+    pattern must compute the identical window set for arbitrary (win, slide),
+    CB and TB, sliding and tumbling, at random batch sizes."""
+    rng = np.random.default_rng(11)
+    for trial in range(6):
+        wt = win_type_t.CB if trial % 2 == 0 else win_type_t.TB
+        slide = int(rng.integers(2, 8))
+        win = slide * int(rng.integers(1, 4))        # multiple: legal for panes
+        K = int(rng.integers(1, 4))
+        total = int(rng.integers(60, 200))
+        bs = int(rng.integers(16, 64))
+        spec = WindowSpec(win, slide, wt)
+        oracle = collect(total, K, Win_Seq(lambda wid, it: it.sum("v"), spec,
+                                           num_keys=K), batch_size=bs)
+        pats = [Key_Farm(lambda wid, it: it.sum("v"), spec, parallelism=2,
+                         num_keys=K),
+                Key_FFAT(lambda t: t.v, jnp.add, spec=spec, num_keys=K),
+                Win_Farm(lambda wid, it: it.sum("v"), spec, parallelism=3,
+                         num_keys=K)]
+        if win > slide:
+            pats.append(Pane_Farm(lambda pid, it: it.sum("v"),
+                                  lambda wid, it: it.sum(), spec, num_keys=K))
+        if wt == win_type_t.CB or win == slide:
+            pats.append(Win_MapReduce(lambda wid, it: it.sum("v"),
+                                      lambda wid, it: it.sum(), spec,
+                                      map_parallelism=2, num_keys=K))
+        for p in pats:
+            got = collect(total, K, p, batch_size=bs)
+            assert got == oracle, (
+                f"trial {trial}: {type(p).__name__} diverges at "
+                f"spec=({win},{slide},{wt}) K={K} total={total} bs={bs}")
