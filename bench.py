@@ -413,6 +413,48 @@ def bench_pallas_ab(shapes=((4096, 512), (1024, 1024), (8192, 256)),
     return rows
 
 
+def bench_native_ring(n: int = 200_000, capacity: int = 1024):
+    """Host-side SPSC ring throughput (tokens/s) across two pinned threads —
+    the FastFlow-role substrate under the threaded driver
+    (``native/spsc_queue.cpp``; reference L0, lock-free SPSC queues). Each
+    token stands for a micro-batch handle, so sustaining ~1M tokens/s carries
+    ~1T tuples/s of stream at 1M-tuple batches — the ring is never the
+    bottleneck. Runs entirely on the host (no device needed)."""
+    import threading
+    from windflow_tpu.native import SPSCQueue, pin_thread
+
+    q = SPSCQueue(capacity)
+    sentinel = object()
+
+    def producer():
+        pin_thread(0)
+        for i in range(n):
+            q.push(i)
+        q.push(sentinel)
+
+    got = []
+
+    def consumer():
+        pin_thread(1)
+        c = 0
+        while True:
+            ok, item = q.pop(spin=1024)
+            if not ok:
+                continue
+            if item is sentinel:
+                break
+            c += 1
+        got.append(c)
+
+    t0 = time.perf_counter()
+    tp = threading.Thread(target=producer)
+    tc = threading.Thread(target=consumer)
+    tc.start(); tp.start(); tp.join(); tc.join()
+    dt = time.perf_counter() - t0
+    assert got[0] == n
+    return n / dt, dt
+
+
 def _run_isolated(call: str, timeout_s: int = 2400):
     """Run ``bench.<call>`` in a FRESH subprocess and return its result.
 
@@ -481,7 +523,21 @@ def main():
     print(f"keyed CB sliding windows (K=512, w=1024 s=512): "
           f"{kc_tps/1e6:.2f} M tuples/s ({kc_step*1e3:.2f} ms/step)",
           file=sys.stderr)
+    from windflow_tpu.native import (hardware_concurrency, native_available,
+                                     queue_selfbench)
+    if native_available():
+        ring_tps = queue_selfbench()
+        print(f"native SPSC ring (raw, C threads): {ring_tps/1e6:.1f} M tokens/s "
+              f"on {hardware_concurrency()} core(s) — each token is a micro-batch "
+              f"handle", file=sys.stderr)
+    else:
+        print("native SPSC ring: skipped (native library unavailable)",
+              file=sys.stderr)
     if os.environ.get("WF_BENCH_ALL"):
+        py_tps, _ = bench_native_ring(200_000)
+        print(f"SPSC ring through the Python binding: {py_tps/1e6:.2f} M "
+              f"handles/s (per-handle ctypes cost; the raw ring above is the "
+              f"C-side number)", file=sys.stderr)
         for k in (1, 500, 10000):
             ks_tps, ks_step = _run_isolated(f"bench_keyed_stateful({k})")
             print(f"keyed-stateful map (K={k}): {ks_tps/1e6:.2f} M tuples/s "

@@ -79,3 +79,14 @@ def test_threaded_pipeline_with_windows():
         for w in range((len(vals) - 1) // 10 + 1):
             expect.append((k, w, sum(vals[w * 10:(w + 1) * 10])))
     assert sorted(got) == sorted(expect)
+
+
+def test_queue_selfbench_moves_tokens():
+    """The raw C selfbench must complete and report sane throughput (> 1 M
+    tokens/s even single-core — short spins + yield batch the handoff)."""
+    from windflow_tpu.native import native_available, queue_selfbench
+    if not native_available():
+        import pytest
+        pytest.skip("native library unavailable")
+    tps = queue_selfbench(200_000, 1024)
+    assert tps > 1e6

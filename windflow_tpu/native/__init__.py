@@ -57,8 +57,11 @@ def _load():
         lib = ctypes.CDLL(_SO)
     except OSError:
         return fail()
-    if not hasattr(lib, "wf_unpack_records"):
-        # stale .so from an older source set: rebuild once, else fall back
+    # NEWEST symbol each source revision adds goes here: a .so missing it is
+    # stale (the library is gitignored and survives pulls) — rebuild once,
+    # else fall back to the pure-Python shims
+    _newest = "wf_queue_selfbench"
+    if not hasattr(lib, _newest):
         del lib
         if not _build():
             return fail()
@@ -66,7 +69,7 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return fail()
-        if not hasattr(lib, "wf_unpack_records"):
+        if not hasattr(lib, _newest):
             return fail()
     lib.wf_queue_create.restype = ctypes.c_void_p
     lib.wf_queue_create.argtypes = [ctypes.c_uint64]
@@ -87,6 +90,8 @@ def _load():
     lib.wf_pin_thread.restype = ctypes.c_int
     lib.wf_pin_thread.argtypes = [ctypes.c_int]
     lib.wf_hardware_concurrency.restype = ctypes.c_int
+    lib.wf_queue_selfbench.restype = ctypes.c_double
+    lib.wf_queue_selfbench.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
     _p = ctypes.POINTER
     lib.wf_unpack_records.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
@@ -292,3 +297,13 @@ def hardware_concurrency() -> int:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def queue_selfbench(n: int = 2_000_000, capacity: int = 1024) -> float:
+    """Raw ring throughput (tokens/s), measured entirely in C across two
+    threads (``wf_queue_selfbench``) — the number the reference's FastFlow
+    SPSC queues compete on. Returns 0.0 without the native library."""
+    lib = _load()
+    if lib is None:
+        return 0.0
+    return float(lib.wf_queue_selfbench(n, capacity))

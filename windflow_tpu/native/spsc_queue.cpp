@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <chrono>
 #include <thread>
 
 #if defined(__linux__)
@@ -122,6 +123,38 @@ int wf_pin_thread(int core) {
 
 int wf_hardware_concurrency() {
     return static_cast<int>(std::thread::hardware_concurrency());
+}
+
+// Self-benchmark of the raw ring (no Python in the loop): producer and
+// consumer threads on cores 0/1 move n tokens; returns tokens/second. This is
+// the number FastFlow's lock-free queues compete on (reference L0).
+double wf_queue_selfbench(uint64_t n, uint64_t capacity) {
+    void* q = wf_queue_create(capacity);
+    // short spins: on a single-core host long spin loops burn whole scheduler
+    // quanta against the peer thread; on multi-core the difference is noise
+    bool multi = std::thread::hardware_concurrency() >= 2;
+    uint64_t spin = multi ? (1 << 12) : 64;
+    auto t0 = std::chrono::steady_clock::now();
+    std::thread prod([&] {
+        if (multi) wf_pin_thread(0);
+        for (uint64_t i = 1; i <= n; ++i) wf_queue_push_spin(q, i, spin);
+    });
+    uint64_t sum = 0;
+    std::thread cons([&] {
+        if (multi) wf_pin_thread(1);
+        uint64_t got = 0, v = 0;
+        while (got < n) {
+            if (wf_queue_pop_spin(q, &v, spin, 1)) { sum += v; ++got; }
+        }
+    });
+    prod.join();
+    cons.join();
+    auto dt = std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - t0).count();
+    wf_queue_destroy(q);
+    // defeat dead-code elimination of the consumer sum
+    if (sum == 0 && n > 0) return -1.0;
+    return static_cast<double>(n) / dt;
 }
 
 }  // extern "C"
