@@ -126,3 +126,64 @@ def test_standard_emitter_partition_variants_agree():
         assert (os_.valid == oo.valid).all()
         assert (os_.id[os_.valid] == oo.id[oo.valid]).all()
         assert (os_.key[os_.valid] == oo.key[oo.valid]).all()
+
+
+def test_fuzz_sharded_chain_random_geometry():
+    """Randomized op x mesh-layout x geometry: the ShardedChain must be
+    oracle-identical to the single-device run for key-axis, dp-axis, and
+    2-D dp x key layouts at arbitrary window specs and batch sizes."""
+    from windflow_tpu.operators.win_patterns import Key_FFAT as KF
+    from windflow_tpu.operators.win_seqffat import Win_SeqFFAT
+    from windflow_tpu.parallel.mesh import make_mesh, make_mesh_2d
+    from windflow_tpu.parallel.sharding import ShardedChain
+    from windflow_tpu.runtime.pipeline import CompiledChain
+    from windflow_tpu.operators.window import WindowSpec
+    from windflow_tpu.basic import win_type_t
+    import windflow_tpu as wf
+
+    rng = np.random.default_rng(23)
+    for trial in range(4):
+        slide = int(rng.integers(2, 6))
+        win = slide * int(rng.integers(1, 4))
+        wt = win_type_t.CB if trial % 2 == 0 else win_type_t.TB
+        K = 8 * int(rng.integers(1, 3))             # divisible by the key axis
+        total = int(rng.integers(100, 300))
+        bs = 8 * int(rng.integers(4, 12))           # divisible by dp axis
+        spec = WindowSpec(win, slide, wt)
+
+        def results(layout):
+            src = wf.Source(lambda i: {"v": ((i * 11) % 17).astype(jnp.float32)},
+                            total=total, num_keys=K)
+            chain = CompiledChain([KF(lambda t: t.v, jnp.add, spec=spec,
+                                      num_keys=K)],
+                                  src.payload_spec(), batch_capacity=bs)
+            if layout == "key":
+                chain = ShardedChain(chain, make_mesh(8, axis="key"), axis="key",
+                                     key_axis="key")
+            elif layout == "dp":
+                chain = ShardedChain(chain, make_mesh(8, axis="dp"), axis="dp")
+            elif layout == "2d":
+                chain = ShardedChain(chain, make_mesh_2d((2, 4),
+                                                         axes=("dp", "key")),
+                                     axis="dp", key_axis="key")
+            out = []
+            for b in src.batches(bs):
+                ob = chain.push(b)
+                v = np.asarray(ob.valid)
+                out.extend(zip(np.asarray(ob.key)[v].tolist(),
+                               np.asarray(ob.id)[v].tolist(),
+                               np.asarray(ob.payload)[v].tolist()))
+            for fb in (chain.flush() or []):
+                v = np.asarray(fb.valid)
+                out.extend(zip(np.asarray(fb.key)[v].tolist(),
+                               np.asarray(fb.id)[v].tolist(),
+                               np.asarray(fb.payload)[v].tolist()))
+            return sorted(out)
+
+        oracle = results("single")
+        assert oracle, f"trial {trial}: no windows fired"
+        for layout in ("key", "dp", "2d"):
+            got = results(layout)
+            assert got == oracle, (
+                f"trial {trial}: layout={layout} diverges at spec=({win},{slide},"
+                f"{wt}) K={K} total={total} bs={bs}")
