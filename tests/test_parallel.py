@@ -132,14 +132,7 @@ def test_fuzz_sharded_chain_random_geometry():
     """Randomized op x mesh-layout x geometry: the ShardedChain must be
     oracle-identical to the single-device run for key-axis, dp-axis, and
     2-D dp x key layouts at arbitrary window specs and batch sizes."""
-    from windflow_tpu.operators.win_patterns import Key_FFAT as KF
-    from windflow_tpu.operators.win_seqffat import Win_SeqFFAT
-    from windflow_tpu.parallel.mesh import make_mesh, make_mesh_2d
-    from windflow_tpu.parallel.sharding import ShardedChain
-    from windflow_tpu.runtime.pipeline import CompiledChain
-    from windflow_tpu.operators.window import WindowSpec
-    from windflow_tpu.basic import win_type_t
-    import windflow_tpu as wf
+    from windflow_tpu.parallel.mesh import make_mesh_2d
 
     rng = np.random.default_rng(23)
     for trial in range(4):
@@ -151,11 +144,17 @@ def test_fuzz_sharded_chain_random_geometry():
         bs = 8 * int(rng.integers(4, 12))           # divisible by dp axis
         spec = WindowSpec(win, slide, wt)
 
+        def collect(ob, acc):
+            o = jax.tree.map(np.asarray, ob)
+            v = o.valid
+            acc.extend(zip(o.key[v].tolist(), o.id[v].tolist(),
+                           np.asarray(jax.tree.leaves(o.payload)[0])[v].tolist()))
+
         def results(layout):
             src = wf.Source(lambda i: {"v": ((i * 11) % 17).astype(jnp.float32)},
                             total=total, num_keys=K)
-            chain = CompiledChain([KF(lambda t: t.v, jnp.add, spec=spec,
-                                      num_keys=K)],
+            chain = CompiledChain([Key_FFAT(lambda t: t.v, jnp.add, spec=spec,
+                                            num_keys=K)],
                                   src.payload_spec(), batch_capacity=bs)
             if layout == "key":
                 chain = ShardedChain(chain, make_mesh(8, axis="key"), axis="key",
@@ -168,16 +167,9 @@ def test_fuzz_sharded_chain_random_geometry():
                                      axis="dp", key_axis="key")
             out = []
             for b in src.batches(bs):
-                ob = chain.push(b)
-                v = np.asarray(ob.valid)
-                out.extend(zip(np.asarray(ob.key)[v].tolist(),
-                               np.asarray(ob.id)[v].tolist(),
-                               np.asarray(ob.payload)[v].tolist()))
-            for fb in (chain.flush() or []):
-                v = np.asarray(fb.valid)
-                out.extend(zip(np.asarray(fb.key)[v].tolist(),
-                               np.asarray(fb.id)[v].tolist(),
-                               np.asarray(fb.payload)[v].tolist()))
+                collect(chain.push(b), out)
+            for fb in chain.flush():
+                collect(fb, out)
             return sorted(out)
 
         oracle = results("single")
