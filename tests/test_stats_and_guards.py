@@ -112,3 +112,27 @@ def test_xprof_trace_produces_a_capture(tmp_path):
         g.run()
     found = [os.path.join(r, f) for r, _, fs in os.walk(logdir) for f in fs]
     assert found, "profiler produced no capture files"
+
+
+def test_pipegraph_dump_stats_writes_per_operator_logs(tmp_path):
+    """PipeGraph.dump_stats: one JSON per operator replica under log_dir with
+    live counters (TRACE_WINDFLOW analogue, wf/stats_record.hpp:109-155)."""
+    import json
+    import jax.numpy as jnp
+    import windflow_tpu as wf
+
+    g = wf.PipeGraph("stats", batch_size=32)
+    (g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=96,
+                            name="gen"))
+     .add(wf.Map(lambda t: {"v": t.v * 2}, name="dbl"))
+     .add(wf.ReduceSink(lambda t: t.v, name="tot")))
+    g.run()
+    paths = g.dump_stats(str(tmp_path))
+    assert len(paths) >= 3
+    names = set()
+    for p in paths:
+        with open(p) as f:
+            rec = json.load(f)
+        names.add(rec["operator"])
+        assert rec["batches_received"] >= 1 or rec["operator"] == "gen"
+    assert {"gen", "dbl", "tot"} <= names
