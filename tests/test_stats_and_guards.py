@@ -118,8 +118,6 @@ def test_pipegraph_dump_stats_writes_per_operator_logs(tmp_path):
     """PipeGraph.dump_stats: one JSON per operator replica under log_dir with
     live counters (TRACE_WINDFLOW analogue, wf/stats_record.hpp:109-155)."""
     import json
-    import jax.numpy as jnp
-    import windflow_tpu as wf
 
     g = wf.PipeGraph("stats", batch_size=32)
     (g.add_source(wf.Source(lambda i: {"v": i.astype(jnp.int32)}, total=96,
