@@ -1,0 +1,88 @@
+"""Verify driver: library end-to-end on CPU + bench capture-persistence paths.
+
+Run as ``python scripts/verify_captures.py`` from the repo root (sys.path gets
+the repo root injected below — PYTHONPATH must stay unset, it breaks the axon
+TPU plugin init).
+"""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+import json
+import subprocess
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.operators.window import WindowSpec
+
+
+def run(op, total=96, K=2, batch=32):
+    src = wf.Source(lambda i: {"v": (i % 7).astype(jnp.float32)}, total=total, num_keys=K)
+    out = []
+    def cb(view):
+        if view is None:
+            return
+        out.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                       np.asarray(view["payload"]).tolist()))
+    wf.Pipeline(src, [op], wf.Sink(cb), batch_size=batch).run()
+    return sorted(out)
+
+
+# 1. end-to-end result invariance under batch size
+mk = lambda: wf.Win_Seq(lambda wid, it: it.sum("v"),
+                        WindowSpec(8, 4, win_type_t.TB), num_keys=2)
+oracle = run(mk(), batch=32)
+assert oracle, "oracle produced no windows"
+for b in (16, 48, 96):
+    got = run(mk(), batch=b)
+    assert got == oracle, f"batch={b} diverged from oracle"
+print(f"end-to-end OK: {len(oracle)} window results invariant under batch 16/32/48/96")
+
+# 2. bench module: record -> load -> stale emission round trip in a subprocess,
+#    with CAPTURE_PATH pointed at a temp store (the committed seed untouched)
+with tempfile.TemporaryDirectory() as td:
+    code = f"""
+import bench, json, sys
+bench.CAPTURE_PATH = {os.path.join(td, 'last_good.json')!r}
+bench.record('ysb', {{'tps': 1.0e8, 'step_s': 0.01, 'batch': 1048576}})
+bench.record_headline({{'metric': 'YSB tuples/sec/chip', 'value': 100000000,
+                        'unit': 'tuples/s', 'vs_baseline': 6.024}},
+                      methodology='verify-driver')
+sys.exit(bench.emit_stale_headline('verify-simulated outage'))
+"""
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    payload = json.loads(line)
+    assert payload["stale"] is True and payload["value"] == 100000000
+    assert payload["methodology"] == "verify-driver"
+print("stale-emission path OK (subprocess, temp store)")
+
+# 3. the committed seed store parses and the real healthcheck path degrades to
+#    rc=0 with a stale line when the probe fails (10s timeout, dead tunnel)
+proc = subprocess.run(
+    [sys.executable, "-c",
+     "import bench; bench._device_healthcheck(timeout_s=10); print('DEVICE-UP')"],
+    capture_output=True, text=True, cwd="/root/repo")
+if "DEVICE-UP" in proc.stdout:
+    print("device reachable — healthcheck passed (stale path not needed)")
+else:
+    assert proc.returncode == 0, f"rc={proc.returncode}: {proc.stderr[-500:]}"
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    payload = json.loads(line)
+    import bench
+    stored = bench._load_store()["headline"]
+    assert payload["stale"] is True
+    assert payload["metric"] == "YSB tuples/sec/chip"
+    assert payload["value"] == stored["value"], (payload, stored)
+    print(f"real healthcheck degraded to stale stored capture OK "
+          f"(value={payload['value']}, captured_at={payload['captured_at']})")
+
+print("VERIFY PASS")

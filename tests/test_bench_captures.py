@@ -1,0 +1,73 @@
+"""Capture persistence: a tunnel outage must degrade the round's perf evidence
+to "stale but real" instead of "absent" (VERDICT r03 item 2).
+
+No device needed: exercises the store round-trip and the stale-emission path
+with CAPTURE_PATH pointed at a temp file.
+"""
+
+import json
+import sys
+
+import bench
+
+
+def _point_store_at(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CAPTURE_PATH",
+                        str(tmp_path / "captures" / "last_good.json"))
+
+
+def test_record_round_trip(tmp_path, monkeypatch):
+    _point_store_at(tmp_path, monkeypatch)
+    bench.record("ysb", {"tps": 1.27e8, "step_s": 8.2e-3, "batch": 1 << 20},
+                 methodology="test")
+    bench.record("stateless", {"tps": 5e8, "step_s": 2.1e-3, "batch": 1 << 20})
+    store = bench._load_store()
+    assert store["captures"]["ysb"]["tps"] == 1.27e8
+    assert store["captures"]["ysb"]["methodology"] == "test"
+    assert "ts" in store["captures"]["ysb"]
+    assert "device" in store["captures"]["stateless"]
+    # updating one key preserves the other
+    bench.record("ysb", {"tps": 2e8, "step_s": 5e-3, "batch": 1 << 20})
+    store = bench._load_store()
+    assert store["captures"]["ysb"]["tps"] == 2e8
+    assert store["captures"]["stateless"]["tps"] == 5e8
+
+
+def test_stale_emission_with_good_capture(tmp_path, monkeypatch, capsys):
+    _point_store_at(tmp_path, monkeypatch)
+    bench.record_headline({"metric": "YSB tuples/sec/chip", "value": 127000000,
+                           "unit": "tuples/s", "vs_baseline": 7.651},
+                          methodology="test-capture")
+    rc = bench.emit_stale_headline("probe timed out")
+    assert rc == 0
+    out = capsys.readouterr()
+    line = [ln for ln in out.out.splitlines() if ln.startswith("{")][-1]
+    payload = json.loads(line)
+    assert payload["stale"] is True
+    assert payload["value"] == 127000000
+    assert payload["metric"] == "YSB tuples/sec/chip"
+    assert payload["staleness_reason"] == "device unreachable at capture time"
+    assert payload["methodology"] == "test-capture"
+    assert "DEVICE UNREACHABLE" in out.err
+
+
+def test_stale_emission_without_capture_is_rc2(tmp_path, monkeypatch, capsys):
+    _point_store_at(tmp_path, monkeypatch)
+    rc = bench.emit_stale_headline("probe timed out")
+    assert rc == 2
+    out = capsys.readouterr()
+    assert not [ln for ln in out.out.splitlines() if ln.startswith("{")]
+
+
+def test_committed_seed_store_is_valid():
+    """The committed seed (r03 session capture) must parse and carry the
+    honesty markers the stale path forwards."""
+    store = bench._load_store()
+    head = store.get("headline")
+    assert head and head["metric"] == "YSB tuples/sec/chip"
+    assert "methodology" in head and "device" in head and "ts" in head
+
+
+def test_fingerprint_never_initializes_jax(monkeypatch):
+    monkeypatch.delitem(sys.modules, "jax", raising=False)
+    assert bench._device_fingerprint() == "unknown (jax not initialized)"
