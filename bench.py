@@ -397,6 +397,39 @@ def bench_scatter(fanout: int, variant: str = "sort"):
     return STEPS * BATCH / dt, dt / STEPS
 
 
+def bench_ordering_overhead(total: int = 200_000, batch: int = 4096):
+    """DETERMINISTIC-vs-DEFAULT merge throughput (the Ordering_Node's hot-path
+    cost — reference inserts an Ordering_Node before each replica in
+    DETERMINISTIC mode, ``wf/pipegraph.hpp:1197-1199``). Two sources -> merge ->
+    map -> reduce, identical streams, both modes; returns
+    (default_tps, deterministic_tps, ratio)."""
+    import jax.numpy as jnp
+    import windflow_tpu as wf
+    from windflow_tpu.basic import Mode
+    from windflow_tpu.runtime.pipegraph import PipeGraph
+
+    def run(mode):
+        g = PipeGraph("ord", mode=mode, batch_size=batch)
+        sa = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=total,
+                       num_keys=8, ts_fn=lambda i: 2 * i, name="a")
+        sb = wf.Source(lambda i: {"v": -i.astype(jnp.float32)}, total=total,
+                       num_keys=8, ts_fn=lambda i: 2 * i + 1, name="b")
+        pa, pb = g.add_source(sa), g.add_source(sb)
+        m = pa.merge(pb)
+        m.add(wf.Map(lambda t: {"v": t.v * 2.0}))
+        m.add(wf.ReduceSink(lambda t: t.v, name="out"))
+        t0 = time.perf_counter()
+        res = g.run()
+        dt = time.perf_counter() - t0
+        return 2 * total / dt, float(res["out"])
+
+    run(Mode.DEFAULT)                       # warm compile caches
+    d_tps, d_sum = run(Mode.DEFAULT)
+    o_tps, o_sum = run(Mode.DETERMINISTIC)
+    assert d_sum == o_sum, (d_sum, o_sum)   # ordering must not change the sum
+    return d_tps, o_tps, o_tps / d_tps
+
+
 def measure_h2d_bandwidth(mb: int = 64, streams: int = 4):
     """Aggregate host->device transfer bandwidth (MB/s): ``streams`` concurrent
     device_put transfers, the way the prefetch path issues them. Incompressible
@@ -461,6 +494,67 @@ def bench_ingest():
     h2d_mbps = measure_h2d_bandwidth()
     ceiling_tps = h2d_mbps * 1e6 / bytes_per_tuple
     return steps * B / dt, dt / steps, ceiling_tps, bytes_per_tuple
+
+
+def bench_ingest_decomposition(n: int = 1 << 20, reps: int = 7):
+    """Split the ingest path into separately-measured terms so the ingest story
+    is arithmetic over constants, not an assertion (VERDICT r03 #5):
+
+    1. host framing — AoS record buffer -> SoA columns (``wf_unpack_records``)
+       and key hashing (``wf_hash_int_keys``), in ns/tuple and GB/s; this is
+       the reference's per-tuple Source cost model (``wf/source.hpp:184``) paid
+       once per batch instead of per tuple;
+    2. transfer — ``device_put`` of the framed columns on THIS backend (the
+       tunnel's 30-80 MB/s, or a real host's multi-GB/s DMA);
+    3. chain — the on-device compute, measured separately by bench_ysb.
+
+    The ingest-inclusive ceiling is min(framing, transfer) by construction
+    (prefetch overlaps them); the returned dict carries each term."""
+    import jax
+    import numpy as np
+    from windflow_tpu.native import (hash_keys_native, native_available,
+                                     unpack_records)
+
+    rec_dt = np.dtype([("ad_id", "<i4"), ("event_type", "<i4"), ("ts", "<i4")])
+    rng = np.random.default_rng(3)
+    buf = np.empty(n, rec_dt)
+    buf["ad_id"] = rng.integers(0, 100000, n, dtype=np.int32)
+    buf["event_type"] = rng.integers(0, 3, n, dtype=np.int32)
+    buf["ts"] = np.arange(n, dtype=np.int32)
+
+    def _median(fn):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    frame_s = _median(lambda: unpack_records(buf))
+    cols = unpack_records(buf)
+    hash_s = (_median(lambda: hash_keys_native(cols["ad_id"], 10007))
+              if native_available() else float("nan"))
+
+    # transfer: the framed columns, H2D, this backend
+    put = lambda: jax.block_until_ready(
+        [jax.device_put(c) for c in cols.values()])
+    put()                                         # warm the path
+    xfer_s = _median(put)
+    col_bytes = sum(c.nbytes for c in cols.values())
+
+    framing_tps = n / (frame_s + (0 if hash_s != hash_s else hash_s))
+    xfer_tps = n / xfer_s
+    return {
+        "native": bool(native_available()),
+        "framing_ns_per_tuple": frame_s / n * 1e9,
+        "framing_gbps": buf.nbytes / frame_s / 1e9,
+        "hash_ns_per_tuple": hash_s / n * 1e9,
+        "transfer_mbps": col_bytes / xfer_s / 1e6,
+        "bytes_per_tuple": buf.nbytes // n,
+        "host_framing_tps": framing_tps,
+        "transfer_tps": xfer_tps,
+        "ingest_ceiling_tps": min(framing_tps, xfer_tps),
+    }
 
 
 def bench_pallas_ab(shapes=((4096, 512), (1024, 1024), (8192, 256)),
@@ -669,6 +763,14 @@ def _secondary_benches(ysb_tps, ysb_step_s):
             print(f"keyed-stateful map (K={k}): {ks_tps/1e6:.2f} M tuples/s "
                   f"({ks_step*1e3:.2f} ms/step)  [CUDA bar: 0.44-0.64M @1, "
                   f"11.8M @500, 10M @10k]", file=sys.stderr)
+        od_tps, oo_tps, oratio = _run_isolated("bench_ordering_overhead()")
+        record("ordering_overhead", {"default_tps": od_tps,
+                                     "deterministic_tps": oo_tps,
+                                     "ratio": oratio},
+               methodology="isolated-subprocess")
+        print(f"DETERMINISTIC merge overhead: {od_tps/1e6:.2f} M t/s DEFAULT vs "
+              f"{oo_tps/1e6:.2f} M t/s DETERMINISTIC ({oratio:.2f}x)",
+              file=sys.stderr)
         for n in (2, 4, 8, 16):
             sc_tps, sc_step = _run_isolated(f"bench_scatter({n}, 'sort')")
             oh_tps, oh_step = _run_isolated(f"bench_scatter({n}, 'onehot')")
@@ -696,6 +798,15 @@ def _secondary_benches(ysb_tps, ysb_step_s):
                           "transport_ceiling_tps": in_ceiling,
                           "bytes_per_tuple": in_bpt},
                methodology="isolated-subprocess")
+        dec = _run_isolated("bench_ingest_decomposition()")
+        record("ingest_decomposition", dec, methodology="isolated-subprocess")
+        print(f"ingest decomposition: framing {dec['framing_ns_per_tuple']:.1f} "
+              f"ns/tuple ({dec['framing_gbps']:.2f} GB/s), hash "
+              f"{dec['hash_ns_per_tuple']:.1f} ns/tuple, transfer "
+              f"{dec['transfer_mbps']:.0f} MB/s -> ingest ceiling "
+              f"{dec['ingest_ceiling_tps']/1e6:.1f} M t/s "
+              f"(host framing alone: {dec['host_framing_tps']/1e6:.1f} M t/s)",
+              file=sys.stderr)
         print(f"ingest-inclusive YSB (host numpy -> prefetch/device_put overlap "
               f"-> full chain): {in_tps/1e6:.2f} M tuples/s ({in_step*1e3:.2f} "
               f"ms/step); measured H2D transport ceiling "

@@ -1,0 +1,12 @@
+#!/bin/bash
+# One fresh process per probe (the r03 measurement-integrity rule); run on the
+# real chip when the tunnel is up. Results append to scripts/join_probes.log.
+cd /root/repo
+LOG=scripts/join_probes.log
+echo "=== $(date -u +%FT%TZ) batch=${1:-1048576}" >> "$LOG"
+for p in prefix2_base prefix2_factored prefix2_factored_bf16 prefix2_take \
+         prefix2_barrier prefix2_div standalone_factored \
+         standalone_factored_bf16 standalone_take standalone_div; do
+  timeout 900 python scripts/probe_join.py "$p" "${1:-1048576}" >> "$LOG" 2>&1
+done
+tail -12 "$LOG"

@@ -1,0 +1,89 @@
+"""Per-process driver for the 2-process multihost smoke test (CPU backend).
+
+Run as: ``python tests/multihost_driver.py <coordinator> <num_procs> <proc_id>``
+from the repo root (cwd provides the windflow_tpu import — PYTHONPATH must stay
+unset in this environment). Each process gets 4 virtual CPU devices; together
+they form the DCN×ICI mesh (key axis across processes, dp axis inside) and run
+``keyed_all_to_all`` across the process boundary.
+
+Prints ``MULTIHOST-OK <n_received>`` on success.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+coordinator, num_procs, proc_id = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from windflow_tpu.parallel import multihost  # noqa: E402 (after platform pin)
+
+# initialize() must run BEFORE any backend query — it probes the distributed
+# client handle, not jax.process_count()
+assert multihost.initialize(coordinator_address=coordinator,
+                            num_processes=num_procs, process_id=proc_id), \
+    "initialize() returned False for an explicit multi-process call"
+
+assert jax.process_count() == num_procs, jax.process_count()
+assert jax.device_count() == num_procs * 4, jax.device_count()
+assert jax.local_device_count() == 4
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from windflow_tpu.parallel.collective import keyed_all_to_all  # noqa: E402
+
+# key axis spans the two hosts over DCN (documented-legal: the keyed exchange
+# then rides DCN); dp spans each host's 4 local chips over ICI
+mesh = multihost.make_dcn_ici_mesh(dcn_axis="key", ici_axes=("dp",))
+assert mesh.devices.shape == (num_procs, 4), mesh.devices.shape
+assert mesh.axis_names == ("key", "dp")
+# outer axis really spans processes: every column of row i lives on process i
+for krow in range(num_procs):
+    procs = {d.process_index for d in mesh.devices[krow].flat}
+    assert len(procs) == 1, f"DCN row {krow} spans processes {procs}"
+
+C = 64                                   # global rows, sharded over the key axis
+exchange = keyed_all_to_all(mesh, axis="key", capacity=C)
+
+gen = jax.jit(lambda: (jnp.arange(C, dtype=jnp.int32) * 7 % 13,
+                       jnp.ones((C,), jnp.bool_),
+                       {"v": jnp.arange(C, dtype=jnp.float32)}),
+              out_shardings=(NamedSharding(mesh, P("key")),
+                             NamedSharding(mesh, P("key")),
+                             NamedSharding(mesh, P("key"))))
+keys, valid, payload = gen()
+out_keys, out_valid, out_pay = exchange(keys, valid, payload)
+
+# every row landed on the key-axis shard that owns its key (owner = key % 2),
+# with its payload riding along
+n_local = 0
+for shard_k, shard_v, shard_p in zip(out_keys.addressable_shards,
+                                     out_valid.addressable_shards,
+                                     out_pay["v"].addressable_shards):
+    coord = np.argwhere(mesh.devices == shard_k.device)
+    assert coord.shape == (1, 2), coord
+    key_coord = int(coord[0][0])
+    kv = np.asarray(shard_k.data)
+    vv = np.asarray(shard_v.data)
+    pv = np.asarray(shard_p.data)
+    assert np.all(kv[vv] % num_procs == key_coord), (key_coord, kv[vv])
+    assert np.all(pv[vv] * 7 % 13 == kv[vv])       # payload stayed with its key
+    n_local += int(vv.sum())
+
+# no row lost in the exchange: global count over both processes == C
+from jax.experimental import multihost_utils  # noqa: E402
+total = int(multihost_utils.process_allgather(jnp.asarray(n_local)).sum())
+# every dp member holds a replicated copy of its host's received rows
+assert total == C * 4, (total, C * 4)
+
+print(f"MULTIHOST-OK {n_local}")

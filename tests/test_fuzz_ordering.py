@@ -13,7 +13,7 @@ import pytest
 
 from windflow_tpu.basic import ordering_mode_t
 from windflow_tpu.batch import Batch
-from windflow_tpu.parallel.ordering import Ordering_Node
+from windflow_tpu.parallel.ordering import Ordering_Node, WM_NONE
 
 def make_batch(keys, ids, ts, vals):
     n = len(ids)
@@ -60,7 +60,8 @@ def test_fuzz_interleaved_channels_release_global_sorted_merge(trial):
         out = node.push(c, make_batch([0] * len(ids), ids, ts, ids))
         before = len(released)
         drain(out, released)
-        wms = [w for w in node._wm if w is not None]
+        wms = [w for w in np.asarray(node._wm_dev).tolist()
+               if w != int(WM_NONE)]
         if len(wms) == node.n_inputs and len(released) > before:
             low = min(wms)
             assert all(t <= low for t, _, _ in released[before:])

@@ -150,3 +150,28 @@ def test_win_seq_incremental_requires_init_acc():
     with pytest.raises(ValueError, match="init_acc"):
         wf.Win_Seq(lambda wid, t, acc: acc + t.v,
                    WindowSpec(8, 8, win_type_t.CB), num_keys=2)
+
+
+def test_flavour_warning_on_unrecognized_context_name():
+    from windflow_tpu.meta import FlavourWarning, classify_map
+    with pytest.warns(FlavourWarning, match="RuntimeContext"):
+        assert classify_map(lambda t, environment: t) is True
+
+
+def test_flavour_warning_on_ambiguous_source_second_param():
+    from windflow_tpu.meta import FlavourWarning, classify_source_flavour
+    with pytest.warns(FlavourWarning, match="LOOP source"):
+        assert classify_source_flavour(lambda i, sender: None) == (False, True)
+    # recognized names stay silent
+    import warnings as w
+    with w.catch_warnings():
+        w.simplefilter("error")
+        assert classify_source_flavour(lambda i, shipper: None) == (True, False)
+        assert classify_source_flavour(lambda i, ctx: i) == (False, True)
+
+
+def test_flavour_warning_on_contextish_window_param():
+    from windflow_tpu.meta import FlavourWarning, classify_window_flavour
+    with pytest.warns(FlavourWarning, match="INCREMENTAL"):
+        assert classify_window_flavour(
+            lambda wid, t, my_ctx: t) == (True, False)

@@ -70,7 +70,7 @@ def test_keyed_map_folds_duplicates_in_order_even_unordered():
     st, out = jax.jit(op.apply)(st, _dup_batch())
     # key 1: running sums 1, then 1+2=3; key 2: 3
     np.testing.assert_allclose(np.asarray(out.payload["v"]), [1.0, 3.0, 3.0])
-    np.testing.assert_allclose(float(st[1]), 3.0)
+    np.testing.assert_allclose(float(st["tbl"][1]), 3.0)
 
 
 def test_keyed_map_static_promise_violation_fails_loudly():
@@ -82,6 +82,36 @@ def test_keyed_map_static_promise_violation_fails_loudly():
         _, out = jax.jit(op.apply)(st, _dup_batch())
         jax.block_until_ready(out.payload["v"])
         jax.effects_barrier()
+
+
+def test_keyed_map_promise_violation_latched_to_flush():
+    """The violation must be reported no later than EOS even if the async
+    debug-callback report never surfaces: apply latches a device flag into the
+    carried state and flush() raises on it synchronously."""
+    op = KeyedMap(lambda t, s: ({"v": s + t.v}, s + t.v), jnp.float32(0),
+                  num_keys=4, max_key_multiplicity=1)
+    st = op.init_state({"v": jax.ShapeDtypeStruct((), jnp.float32)})
+    # the async callback may surface during apply (eager backends) or the
+    # latched flag raises at flush — either way the violation cannot reach EOS
+    # unreported
+    with pytest.raises(Exception,
+                       match="max_key_multiplicity|callback|CpuCallback"):
+        st, _ = jax.jit(op.apply)(st, _dup_batch())
+        op.flush(st)
+
+
+def test_keyed_map_flush_clean_when_promise_kept():
+    op = KeyedMap(lambda t, s: ({"v": s + t.v}, s + t.v), jnp.float32(0),
+                  num_keys=4, max_key_multiplicity=1)
+    st = op.init_state({"v": jax.ShapeDtypeStruct((), jnp.float32)})
+    from windflow_tpu.batch import Batch
+    b = Batch(key=jnp.asarray([0, 1, 2], jnp.int32),
+              id=jnp.arange(3, dtype=jnp.int32), ts=jnp.arange(3, dtype=jnp.int32),
+              payload={"v": jnp.ones(3, jnp.float32)},
+              valid=jnp.ones(3, bool))
+    st, _ = jax.jit(op.apply)(st, b)
+    st, out = op.flush(st)
+    assert out is None
 
 
 def test_keyed_map_fast_path_ok_without_duplicates():

@@ -26,9 +26,20 @@ Accepted signatures (per-tuple functions run under ``vmap``; ``t`` is a
 from __future__ import annotations
 
 import inspect
+import warnings
 from typing import Callable
 
 RICH_PARAM_NAMES = ("ctx", "context", "rc")
+
+
+class FlavourWarning(UserWarning):
+    """A flavour was deduced from a parameter NAME that is not in the
+    recognized list — the deduction proceeds (documented behavior, docs/API.md)
+    but the name suggests the user may have meant the other flavour."""
+
+
+def _warn_flavour(msg: str) -> None:
+    warnings.warn(msg, FlavourWarning, stacklevel=4)
 
 
 class SignatureError(TypeError):
@@ -58,6 +69,11 @@ def classify(fn: Callable, *, base_arity: int, what: str, accepted: str):
     if n == base_arity:
         return False
     if n == base_arity + 1:
+        if params[-1].name not in RICH_PARAM_NAMES:
+            _warn_flavour(
+                f"{what}: trailing parameter {params[-1].name!r} is treated as "
+                f"the RuntimeContext (rich flavour); name it one of "
+                f"{RICH_PARAM_NAMES} to silence this warning")
         return True
     raise SignatureError(
         f"{what}: callable takes {n} positional parameters; accepted signatures are:\n"
@@ -111,7 +127,15 @@ def classify_source_flavour(fn):
         # a shipper-named 2nd param selects the loop flavour; any other name is
         # treated as the context (the itemized rich form — arity compatibility
         # with plain classify_source)
-        return (True, False) if names[1] in SHIPPER_PARAM_NAMES else (False, True)
+        if names[1] in SHIPPER_PARAM_NAMES:
+            return True, False
+        if names[1] not in RICH_PARAM_NAMES:
+            _warn_flavour(
+                f"Source: parameter {names[1]!r} is treated as the "
+                f"RuntimeContext (itemized rich flavour); for a LOOP source "
+                f"name it one of {SHIPPER_PARAM_NAMES}, for a context one of "
+                f"{RICH_PARAM_NAMES}")
+        return False, True
     if n == 3 and names[1] in SHIPPER_PARAM_NAMES:
         return True, True
     raise SignatureError(
@@ -134,7 +158,15 @@ def classify_window_flavour(fn):
     if n == 2:
         return False, False
     if n == 3:
-        return (False, True) if names[-1] in RICH_PARAM_NAMES else (True, False)
+        if names[-1] in RICH_PARAM_NAMES:
+            return False, True
+        if any(m in names[-1].lower() for m in ("ctx", "context")):
+            _warn_flavour(
+                f"Window function: parameter {names[-1]!r} looks like a "
+                f"context but is not named one of {RICH_PARAM_NAMES}, so the "
+                f"INCREMENTAL flavour (f(wid, t, acc)) was deduced; rename it "
+                f"if you meant the non-incremental rich form")
+        return True, False
     if n == 4 and names[-1] in RICH_PARAM_NAMES:
         return True, True
     raise SignatureError(

@@ -57,11 +57,11 @@ def _load():
         lib = ctypes.CDLL(_SO)
     except OSError:
         return fail()
-    # NEWEST symbol each source revision adds goes here: a .so missing it is
-    # stale (the library is gitignored and survives pulls) — rebuild once,
-    # else fall back to the pure-Python shims
-    _newest = "wf_queue_selfbench"
-    if not hasattr(lib, _newest):
+    if not _bind(lib):
+        # stale .so: it predates some symbol in _SYMBOLS (the library is
+        # gitignored and survives pulls) — rebuild once, else fall back to the
+        # pure-Python shims. Staleness is derived from the SAME table the
+        # binding uses, so it cannot drift from the binding code.
         del lib
         if not _build():
             return fail()
@@ -69,46 +69,55 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return fail()
-        if not hasattr(lib, _newest):
+        if not _bind(lib):
             return fail()
-    lib.wf_queue_create.restype = ctypes.c_void_p
-    lib.wf_queue_create.argtypes = [ctypes.c_uint64]
-    lib.wf_queue_destroy.argtypes = [ctypes.c_void_p]
-    lib.wf_queue_push.restype = ctypes.c_int
-    lib.wf_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-    lib.wf_queue_pop.restype = ctypes.c_int
-    lib.wf_queue_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
-    lib.wf_queue_push_spin.restype = ctypes.c_int
-    lib.wf_queue_push_spin.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                       ctypes.c_uint64]
-    lib.wf_queue_pop_spin.restype = ctypes.c_int
-    lib.wf_queue_pop_spin.argtypes = [ctypes.c_void_p,
-                                      ctypes.POINTER(ctypes.c_uint64),
-                                      ctypes.c_uint64, ctypes.c_uint64]
-    lib.wf_queue_size.restype = ctypes.c_uint64
-    lib.wf_queue_size.argtypes = [ctypes.c_void_p]
-    lib.wf_pin_thread.restype = ctypes.c_int
-    lib.wf_pin_thread.argtypes = [ctypes.c_int]
-    lib.wf_hardware_concurrency.restype = ctypes.c_int
-    lib.wf_queue_selfbench.restype = ctypes.c_double
-    lib.wf_queue_selfbench.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
-    _p = ctypes.POINTER
-    lib.wf_unpack_records.argtypes = [
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
-        _p(ctypes.c_uint64), _p(ctypes.c_uint64), _p(ctypes.c_char_p)]
-    lib.wf_pack_records.argtypes = [
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
-        _p(ctypes.c_uint64), _p(ctypes.c_uint64), _p(ctypes.c_char_p)]
-    lib.wf_hash_str_keys.argtypes = [
-        ctypes.c_char_p, _p(ctypes.c_int64), ctypes.c_uint64, ctypes.c_uint32,
-        _p(ctypes.c_int32)]
-    lib.wf_hash_fixed_str_keys.argtypes = [
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
-        ctypes.c_uint32, _p(ctypes.c_int32)]
-    lib.wf_hash_int_keys.argtypes = [
-        _p(ctypes.c_int64), ctypes.c_uint64, ctypes.c_uint32, _p(ctypes.c_int32)]
     _lib = lib
     return lib
+
+
+_P = ctypes.POINTER
+#: every exported symbol with its signature — the single source of truth for
+#: both binding and stale-.so detection (None restype = ctypes default c_int)
+_SYMBOLS = [
+    ("wf_queue_create", ctypes.c_void_p, [ctypes.c_uint64]),
+    ("wf_queue_destroy", None, [ctypes.c_void_p]),
+    ("wf_queue_push", ctypes.c_int, [ctypes.c_void_p, ctypes.c_uint64]),
+    ("wf_queue_pop", ctypes.c_int, [ctypes.c_void_p, _P(ctypes.c_uint64)]),
+    ("wf_queue_push_spin", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]),
+    ("wf_queue_pop_spin", ctypes.c_int,
+     [ctypes.c_void_p, _P(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_uint64]),
+    ("wf_queue_size", ctypes.c_uint64, [ctypes.c_void_p]),
+    ("wf_pin_thread", ctypes.c_int, [ctypes.c_int]),
+    ("wf_hardware_concurrency", ctypes.c_int, []),
+    ("wf_queue_selfbench", ctypes.c_double, [ctypes.c_uint64, ctypes.c_uint64]),
+    ("wf_unpack_records", None,
+     [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+      _P(ctypes.c_uint64), _P(ctypes.c_uint64), _P(ctypes.c_char_p)]),
+    ("wf_pack_records", None,
+     [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+      _P(ctypes.c_uint64), _P(ctypes.c_uint64), _P(ctypes.c_char_p)]),
+    ("wf_hash_str_keys", None,
+     [ctypes.c_char_p, _P(ctypes.c_int64), ctypes.c_uint64, ctypes.c_uint32,
+      _P(ctypes.c_int32)]),
+    ("wf_hash_fixed_str_keys", None,
+     [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+      ctypes.c_uint32, _P(ctypes.c_int32)]),
+    ("wf_hash_int_keys", None,
+     [_P(ctypes.c_int64), ctypes.c_uint64, ctypes.c_uint32, _P(ctypes.c_int32)]),
+]
+
+
+def _bind(lib) -> bool:
+    """Bind every symbol in ``_SYMBOLS``; False if any is missing (stale .so)."""
+    for name, restype, argtypes in _SYMBOLS:
+        if not hasattr(lib, name):
+            return False
+        fn = getattr(lib, name)
+        if restype is not None:
+            fn.restype = restype
+        fn.argtypes = argtypes
+    return True
 
 
 class SPSCQueue:

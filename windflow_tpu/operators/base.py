@@ -28,6 +28,18 @@ class Basic_Operator:
     #: set by subclasses
     routing: routing_modes_t = routing_modes_t.FORWARD
 
+    #: builder hints (withBatch / withDevice, the reference GPU builders'
+    #: batch_len / gpu_id, ``wf/builders_gpu.hpp:115-130``): micro-batch
+    #: capacity ceiling honored by CompiledChain/Pipeline, and the jax.Device
+    #: the operator's state (and therefore its fused chain) is placed on.
+    _batch_hint: int = None
+    _device = None
+    #: outcome of MultiPipe.chain() vs add(): True when the operator was fused
+    #: queue-free (FORWARD, reference ``chain_operator`` success,
+    #: ``wf/pipegraph.hpp:1272-1318``), False when it fell back to routed add;
+    #: None before graph placement. Rendered by dump_DOTGraph.
+    _chained = None
+
     def __init__(self, name: str, parallelism: int = 1):
         self._name = name
         self._parallelism = max(1, int(parallelism))

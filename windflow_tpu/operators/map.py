@@ -130,9 +130,11 @@ class KeyedMap(Basic_Operator):
     (results.org:8,37), paid only within a batch and only when duplicates occur.
 
     ``max_key_multiplicity=1`` is a *static* promise that batches never hold same-key
-    duplicates: the fallback branch is not even compiled, and a violated promise
-    fails loudly (asynchronously, at the next sync point) instead of dropping state
-    updates. ``ordered`` is kept for API compatibility and no longer weakens
+    duplicates: the fallback branch is not even compiled. A violated promise fails
+    loudly twice over: asynchronously at the next sync point (debug callback), AND
+    deterministically at ``flush()`` — the violation is latched into the carried
+    state as a device flag, so even if the process never syncs mid-stream the EOS
+    flush raises. ``ordered`` is kept for API compatibility and no longer weakens
     semantics."""
 
     routing = routing_modes_t.KEYBY
@@ -148,9 +150,10 @@ class KeyedMap(Basic_Operator):
         self.max_key_multiplicity = max_key_multiplicity
 
     def init_state(self, payload_spec: Any):
-        return jax.tree.map(
+        tbl = jax.tree.map(
             lambda v: jnp.broadcast_to(jnp.asarray(v), (self.num_keys,) + jnp.shape(jnp.asarray(v))).copy(),
             self.init_value)
+        return {"tbl": tbl, "bad": jnp.zeros((), jnp.bool_)}
 
     def out_spec(self, payload_spec: Any) -> Any:
         t = TupleRef(key=jax.ShapeDtypeStruct((), jnp.int32),
@@ -161,6 +164,8 @@ class KeyedMap(Basic_Operator):
 
     def apply(self, state, batch: Batch):
         from ..ops.segment import segment_rank
+        bad = state["bad"]
+        state = state["tbl"]
         refs = tuple_refs(batch)
         rank = segment_rank(batch.key, batch.valid)
         max_rank = jnp.max(jnp.where(batch.valid, rank, 0))
@@ -200,13 +205,27 @@ class KeyedMap(Basic_Operator):
             return jax.lax.fori_loop(0, max_rank + 1, round_body, (st, out0))
 
         if self.max_key_multiplicity == 1:
-            # static promise: no fallback branch compiled; a violated promise fails
-            # loudly (async, at the next sync point) instead of dropping updates
+            # static promise: no fallback branch compiled; a violated promise
+            # fails loudly early (async debug callback) and is ALSO latched
+            # into the carried state so flush() raises deterministically
             jax.debug.callback(_reject_duplicate_keys, max_rank, self.name)
+            bad = bad | (max_rank > 0)
             state, res = fast(state)
         else:
             state, res = jax.lax.cond(max_rank == 0, fast, multi, state)
-        return state, batch.with_payload(res)
+        return {"tbl": state, "bad": bad}, batch.with_payload(res)
+
+    def flush(self, state):
+        """EOS: no residual output, but the guaranteed (synchronous) report
+        point for a violated ``max_key_multiplicity=1`` promise."""
+        import numpy as np
+        if self.max_key_multiplicity == 1 and bool(np.asarray(state["bad"])):
+            raise ValueError(
+                f"KeyedMap '{self.name}': some batch held same-key duplicates, "
+                f"violating the max_key_multiplicity=1 promise (the single-round "
+                f"path dropped state updates); remove max_key_multiplicity=1 to "
+                f"get the dynamic in-order fallback")
+        return state, None
 
 
 def _reject_duplicate_keys(max_rank, name):

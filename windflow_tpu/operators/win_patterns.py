@@ -223,24 +223,22 @@ class Pane_Farm(Basic_Operator):
     def out_spec(self, payload_spec: Any) -> Any:
         return self.wlq.out_spec(self.plq.out_spec(payload_spec))
 
-    def _fix_pane_batch(self, panes: Batch) -> Batch:
-        """Pane results enter WLQ as a tuple stream; for TB mode their ts must be the
-        pane close time (set by Win_Seq already for TB panes)."""
-        return panes
-
     def set_window_sharding(self, mesh, axis: str) -> None:
         self.plq.set_window_sharding(mesh, axis)
         self.wlq.set_window_sharding(mesh, axis)
 
+    # Pane results enter WLQ directly: Win_Seq already stamps TB pane results
+    # with the pane close time, so no ts fix-up is needed between the stages.
+
     def apply(self, state, batch: Batch):
         st_p, panes = self.plq.apply(state["plq"], batch)
-        st_w, out = self.wlq.apply(state["wlq"], self._fix_pane_batch(panes))
+        st_w, out = self.wlq.apply(state["wlq"], panes)
         return {"plq": st_p, "wlq": st_w}, out
 
     def flush(self, state):
         st_p, panes = self.plq.flush(state["plq"])
         if panes is not None:
-            st_w, out = self.wlq.apply(state["wlq"], self._fix_pane_batch(panes))
+            st_w, out = self.wlq.apply(state["wlq"], panes)
             return {"plq": st_p, "wlq": st_w}, out
         st_w, out = self.wlq.flush(state["wlq"])
         return {"plq": st_p, "wlq": st_w}, out

@@ -58,6 +58,9 @@ class Standard_Emitter(Basic_Emitter):
         # "sort" (stable argsort grouping) or "onehot" (sort-free cumsum ranks) —
         # the two formulations of the reference's scattering study
         # (src/GPU_Tests/scattering); bench.py A/Bs them per fan-out
+        if partition not in ("sort", "onehot"):
+            raise ValueError(f"Standard_Emitter: partition must be 'sort' or "
+                             f"'onehot', got {partition!r}")
         self.partition = partition
         self._rr = 0
         self._jit_part = jax.jit(self._partition, static_argnums=(1,))

@@ -42,8 +42,14 @@ def initialize(coordinator_address: Optional[str] = None,
                process_id: Optional[int] = None) -> bool:
     """Initialize ``jax.distributed`` when running multi-process; no-op (returns
     False) when single-process or already initialized. Arguments default to the
-    standard env-based auto-detection (JAX_COORDINATOR_ADDRESS etc.)."""
-    if jax.process_count() > 1:
+    standard env-based auto-detection (JAX_COORDINATOR_ADDRESS etc.).
+
+    The already-initialized probe reads the distributed client handle, NOT
+    ``jax.process_count()`` — querying the backend would itself initialize it,
+    after which ``jax.distributed.initialize`` is too late (2-process smoke
+    test caught exactly that)."""
+    from jax._src import distributed as _dist
+    if getattr(_dist.global_state, "client", None) is not None:
         return False                              # already initialized
     if coordinator_address is None and num_processes is None:
         import os
@@ -70,11 +76,20 @@ def make_dcn_ici_mesh(dcn_axis: str = "dp",
     if ici_shape is None:
         ici_shape = _factor(local, len(ici_axes))
     if n_proc > 1:
-        from jax.experimental import mesh_utils
-        devs = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=ici_shape, dcn_mesh_shape=(n_proc,) + (1,) * (len(ici_shape) - 1))
-        # hybrid mesh returns [dcn*ici0, ici1, ...]; reshape to (dcn, *ici)
-        devs = devs.reshape((n_proc,) + tuple(ici_shape))
+        try:
+            from jax.experimental import mesh_utils
+            devs = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=ici_shape,
+                dcn_mesh_shape=(n_proc,) + (1,) * (len(ici_shape) - 1))
+            # hybrid mesh returns [dcn*ici0, ici1, ...]; reshape to (dcn, *ici)
+            devs = devs.reshape((n_proc,) + tuple(ici_shape))
+        except ValueError:
+            # backends without slice topology info (e.g. multi-process CPU):
+            # the DCN grouping is by owning process, which is what the outer
+            # axis means — row i = process i's local devices
+            devs = np.array(sorted(jax.devices(),
+                                   key=lambda d: (d.process_index, d.id)))
+            devs = devs.reshape((n_proc,) + tuple(ici_shape))
         return Mesh(devs, (dcn_axis,) + tuple(ici_axes))
     devs = np.array(jax.devices()).reshape((1,) + tuple(ici_shape))
     return Mesh(devs, (dcn_axis,) + tuple(ici_axes))

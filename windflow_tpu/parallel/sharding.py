@@ -25,25 +25,43 @@ from ..batch import Batch
 from ..runtime.pipeline import CompiledChain
 
 
-def _state_sharding(op, state, mesh: Mesh, axis: str):
+def _state_sharding(op, state, mesh: Mesh, axis: str,
+                    window_key_axis: Optional[str] = None):
     """Shard rule for one operator's state pytree, dispatched on the op's declared
     ``shard_axis``:
 
     - ``"key"`` (Key_Farm/Key_FFAT): leaves whose leading dim is the op's key-table
       size shard their key axis (KF_Emitter whole-key routing as a placement rule);
       everything else replicates.
-    - ``"window"`` (Win_Farm): the state (archive rings) REPLICATES — every chip
-      sees every tuple, the WF_Emitter multicast (``wf/wf_nodes.hpp:182-204``) as a
-      sharding rule — and the fired-window [W] axis partitions *inside* the program
-      via the ``with_sharding_constraint`` set by :meth:`Win_Seq.set_window_sharding`.
+    - ``"window"`` (Win_Farm): the fired-window [W] axis partitions *inside* the
+      program via the ``with_sharding_constraint`` set by
+      :meth:`Win_Seq.set_window_sharding`. The archive rings REPLICATE by default
+      (every chip sees every tuple — the WF_Emitter multicast,
+      ``wf/wf_nodes.hpp:182-204``, as a sharding rule); with an explicit
+      ``window_key_axis`` (2-D key x win layouts) a KEYED farm's [K, ...] archive
+      shards its key axis instead — the reference distributes a keyed Win_Farm's
+      tuples by ``hash(key) % pardegree`` before the window round-robin
+      (``wf/wf_nodes.hpp:157-204``), so at large K full replication wastes HBM.
     """
     shard_axis = getattr(op, "shard_axis", "key")
     num_keys = getattr(op, "num_keys", None)
+    if shard_axis == "window":
+        key_ax = window_key_axis
+
+        def place_win(leaf):
+            if (key_ax is not None and num_keys is not None and num_keys > 1
+                    and getattr(leaf, "ndim", 0) >= 1
+                    and leaf.shape[0] == num_keys
+                    and num_keys % mesh.shape[key_ax] == 0):
+                return NamedSharding(mesh, P(key_ax))
+            return NamedSharding(mesh, P())
+        return jax.tree.map(place_win, state)
 
     def place(leaf):
         if (shard_axis == "key" and num_keys is not None
                 and getattr(leaf, "ndim", 0) >= 1
-                and leaf.shape[0] == num_keys and num_keys % mesh.devices.size == 0):
+                and leaf.shape[0] == num_keys
+                and num_keys % mesh.shape.get(axis, mesh.devices.size) == 0):
             return NamedSharding(mesh, P(axis))
         return NamedSharding(mesh, P())
     return jax.tree.map(place, state)
@@ -67,7 +85,12 @@ class ShardedChain:
     ``win_axis``) to place key tables / fired-window rows on a different mesh
     axis than the batch: batch over ``dp`` (operator replication), key state
     over ``key`` (KF whole-key routing), window rows over ``win`` (WF window
-    ownership) — the dp x ep / dp x sp layouts of the scaling playbook."""
+    ownership) — the dp x ep / dp x sp layouts of the scaling playbook.
+
+    A KEYED window farm on a ``key x win`` mesh gets BOTH: its [K, ...] archive
+    shards over ``key_axis`` (explicit key_axis only — on a 1-D mesh the
+    archive stays replicated, the WF-multicast rule) while its fired-window [W]
+    rows shard over ``win_axis``."""
 
     def __init__(self, chain: CompiledChain, mesh: Mesh, axis: str = "dp",
                  win_axis: Optional[str] = None, key_axis: Optional[str] = None):
@@ -80,7 +103,8 @@ class ShardedChain:
                 op.set_window_sharding(mesh, win_axis or axis)
         chain._steps = {}        # drop programs traced before shardings were set
         chain.states = [
-            jax.device_put(st, _state_sharding(op, st, mesh, key_axis or axis))
+            jax.device_put(st, _state_sharding(op, st, mesh, key_axis or axis,
+                                               window_key_axis=key_axis))
             if st is not None else None
             for op, st in zip(chain.ops, chain.states)]
 

@@ -43,6 +43,10 @@ class _Builder:
     def __init__(self, *fns):
         self._fns = fns
         self._kw: dict = {}
+        self._batch_hint: Optional[int] = None
+        self._device = None
+        self._opt: Optional[opt_level_t] = None
+        self._closing: Optional[Callable] = None
 
     def withName(self, name: str):
         self._kw["name"] = name
@@ -53,16 +57,30 @@ class _Builder:
         return self
 
     def withOpt(self, level: opt_level_t):
-        # XLA fuses chained stages unconditionally; kept for parity (wf/basic.hpp:92)
-        self._opt = level
+        """Optimization level (wf/basic.hpp:92). XLA fuses chained stages
+        unconditionally, so every level executes as LEVEL2; recorded on the
+        operator for introspection parity."""
+        self._opt = opt_level_t(level)
         return self
 
     def withBatch(self, batch_len: int):
-        self._kw.setdefault("_batch_hint", batch_len)
+        """Micro-batch capacity for this operator (reference GPU builders'
+        ``withBatch(batch_len)``, wf/builders_gpu.hpp:115-122). Honored as a
+        capacity CEILING by Pipeline/PipeGraph batch-size resolution: a fused
+        chain runs at min over its operators' hints when no explicit
+        batch_size is given."""
+        if int(batch_len) < 1:
+            raise ValueError(f"withBatch: batch_len must be >= 1, got {batch_len}")
+        self._batch_hint = int(batch_len)
         return self
 
     def withDevice(self, device):
-        self._kw.setdefault("_device", device)
+        """Place this operator's state on ``device`` (a ``jax.Device``) — the
+        reference's ``withGPU(gpu_id, ...)`` device-selection half
+        (wf/builders_gpu.hpp:123-130). The fused chain containing the operator
+        executes on that device; conflicting hints inside one chain are a
+        build-time error."""
+        self._device = device
         return self
 
     def withClosingFunction(self, fn: Callable):
@@ -71,15 +89,19 @@ class _Builder:
         self._closing = fn
         return self
 
-    def _pop_private(self):
-        self._kw.pop("_batch_hint", None)
-        self._kw.pop("_device", None)
+    def _construct(self):
+        return self._cls(*self._fns, **self._kw)
 
     def build(self):
-        self._pop_private()
-        op = self._cls(*self._fns, **self._kw)
-        if getattr(self, "_closing", None) is not None:
+        op = self._construct()
+        if self._closing is not None:
             op.closing_func = self._closing
+        if self._batch_hint is not None:
+            op._batch_hint = self._batch_hint
+        if self._device is not None:
+            op._device = self._device
+        if self._opt is not None:
+            op._opt_level = self._opt
         return op
 
     # C++ API parity aliases (wf/builders.hpp:583-643)
@@ -105,8 +127,7 @@ class Source_Builder(_Builder):
         self._kw["ts_fn"] = ts_fn
         return self
 
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         if "total" not in self._kw:
             raise ValueError("Source_Builder: withTotal(n) is required")
         return DeviceSource(*self._fns, **self._kw)
@@ -138,8 +159,7 @@ class FlatMap_Builder(_Builder):
         self._kw["max_fanout"] = f
         return self
 
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         if "max_fanout" not in self._kw:
             raise ValueError("FlatMap_Builder: withMaxFanout(F) is required (static "
                              "fan-out capacity makes 1:N XLA-static)")
@@ -215,8 +235,7 @@ class WinSeq_Builder(_WinBuilder):
         self._kw["init_acc"] = init_acc
         return self
 
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         return Win_Seq(self._fns[0], self._spec(), **self._kw)
 
 
@@ -226,8 +245,7 @@ class WinSeqFFAT_Builder(_WinBuilder):
         self._kw["identity"] = identity
         return self
 
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         lift, comb = self._fns
         return Win_SeqFFAT(lift, comb, spec=self._spec(), **self._kw)
 
@@ -248,8 +266,7 @@ class WinFarm_Builder(_WinBuilder):
     """wf/builders.hpp:1120. Accepts a window function, or a built Pane_Farm /
     Win_MapReduce for the nesting ctors (``wf/win_farm.hpp:266-355``) — in that case
     the window spec comes from the inner pattern."""
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         inner = self._fns[0]
         if isinstance(inner, (Pane_Farm, Win_MapReduce)):
             return Win_Farm(inner, **_nesting_kw("WinFarm_Builder", self._win,
@@ -260,8 +277,7 @@ class WinFarm_Builder(_WinBuilder):
 class KeyFarm_Builder(_WinBuilder):
     """wf/builders.hpp:1343. Accepts a window function, or a built Pane_Farm /
     Win_MapReduce for the nesting ctors (``wf/key_farm.hpp:155-167``)."""
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         inner = self._fns[0]
         if isinstance(inner, (Pane_Farm, Win_MapReduce)):
             return Key_Farm(inner, **_nesting_kw("KeyFarm_Builder", self._win,
@@ -275,8 +291,7 @@ class KeyFFAT_Builder(_WinBuilder):
         self._kw["identity"] = identity
         return self
 
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         lift, comb = self._fns
         return Key_FFAT(lift, comb, spec=self._spec(), **self._kw)
 
@@ -291,8 +306,7 @@ class PaneFarm_Builder(_WinBuilder):
         self._kw["wlq_parallelism"] = n
         return self
 
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         self._kw.pop("parallelism", None)
         plq, wlq = self._fns
         return Pane_Farm(plq, wlq, self._spec(), **self._kw)
@@ -304,8 +318,7 @@ class WinMapReduce_Builder(_WinBuilder):
         self._kw["map_parallelism"] = n
         return self
 
-    def build(self):
-        self._pop_private()
+    def _construct(self):
         self._kw.pop("parallelism", None)
         m, r = self._fns
         return Win_MapReduce(m, r, self._spec(), **self._kw)

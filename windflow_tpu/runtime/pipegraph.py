@@ -45,12 +45,25 @@ from .pipeline import CompiledChain
 
 
 class AppNode:
-    """Node of the Application Tree (``wf/pipegraph.hpp:64-75``)."""
+    """Node of the Application Tree (``wf/pipegraph.hpp:64-75``).
+
+    A merge removes the absorbed pipes' nodes from the forest (the reference
+    deletes them, ``wf/pipegraph.hpp:846-858``): ``absorbed`` is set, ``parent``
+    cleared, and split-parent children lists are re-pointed at the merged node —
+    so the live forest is exactly the nodes with ``absorbed == False``."""
 
     def __init__(self, mp: "MultiPipe", parent: Optional["AppNode"] = None):
         self.mp = mp
         self.parent = parent
         self.children: List[AppNode] = []
+        self.absorbed = False
+
+    def absorb(self) -> None:
+        """Detach this node (and its subtree) from the live forest."""
+        self.absorbed = True
+        self.parent = None
+        for c in self.children:
+            c.absorb()
 
 
 class MultiPipe:
@@ -89,17 +102,23 @@ class MultiPipe:
                 f"add_sink()/chain_sink() (in-graph reductions stay addable via "
                 f"ReduceSink)")
         op._mark_used()
+        op._chained = False
         self.graph._register(op)
         self.ops.append(op)
         return self
 
     def chain(self, op: Basic_Operator) -> "MultiPipe":
+        """Queue-free fusion when the operator is FORWARD; silent fallback to
+        ``add()`` otherwise — exactly the reference's behavior
+        (``wf/pipegraph.hpp:1602-1640``: KEYBY or unchainable ops fall through
+        to add). The outcome is recorded on the operator (``_chained``) and
+        rendered distinctly by ``dump_DOTGraph``, mirroring the reference's
+        ``gv_chain_vertex`` vs add-vertex distinction."""
         from ..basic import routing_modes_t
-        if op.getRoutingMode() not in (routing_modes_t.FORWARD, routing_modes_t.NONE):
-            # the reference only chains FORWARD ops (wf/pipegraph.hpp:1272-1318);
-            # keyed ops route in-program here, so this is advisory parity
-            pass
-        return self.add(op)
+        self.add(op)
+        op._chained = op.getRoutingMode() in (routing_modes_t.FORWARD,
+                                              routing_modes_t.NONE)
+        return self
 
     def add_sink(self, sink: Sink) -> "MultiPipe":
         self._check_open()
@@ -181,12 +200,18 @@ class MultiPipe:
             for c in parent_node.children:
                 ci = _child_idxs(c)
                 if ci and ci <= target:
+                    c.absorb()
                     if not replaced:
                         new_children.append(node)
                         replaced = True
                 else:
                     new_children.append(c)
             parent_node.children = new_children
+        else:
+            # root-level merge (merge-ind / merge-full of whole roots): the
+            # absorbed roots leave the forest, like the partial case above
+            for p in pipes:
+                self.graph._node_of(p).absorb()
         for p in pipes:
             p._outputs_to.append(merged)
         self.graph._nodes[id(merged)] = node
@@ -228,9 +253,12 @@ class PipeGraph:
     """The streaming environment (``wf/pipegraph.hpp:104-244``)."""
 
     def __init__(self, name: str = "pipegraph", mode: Mode = Mode.DEFAULT,
-                 batch_size: int = DEFAULT_BATCH_SIZE):
+                 batch_size: int = None):
         self.name = name
         self.mode = mode
+        #: None = resolve at start(): min withBatch hint over registered
+        #: operators (capacity ceilings, wf/builders_gpu.hpp:115-122), else
+        #: DEFAULT_BATCH_SIZE; an explicit value always wins.
         self.batch_size = batch_size
         self._roots: List[MultiPipe] = []
         self._merged_roots: List[MultiPipe] = []
@@ -264,6 +292,10 @@ class PipeGraph:
         return self.wait_end()
 
     def start(self):
+        if self.batch_size is None:
+            from .pipeline import resolve_batch_hint
+            self.batch_size = (resolve_batch_hint(self._operators)
+                               or DEFAULT_BATCH_SIZE)
         self._started = True
 
     def run_supervised(self, *, checkpoint_every: int = 8,
@@ -348,17 +380,20 @@ class PipeGraph:
                             live.remove(q)
                             if onode is not None and id(q) in channel_of:
                                 rel = onode.close_channel(channel_of[id(q)])
-                                for piece in self._chunks(rel):
+                                for piece in self._chunks(
+                                        rel, onode.last_release_count):
                                     run_batch(piece)
                             continue
                         if onode is not None and id(q) in channel_of:
                             rel = onode.push(channel_of[id(q)], item)
-                            for piece in self._chunks(rel):
+                            for piece in self._chunks(
+                                    rel, onode.last_release_count):
                                 run_batch(piece)
                         else:
                             run_batch(item)
                 if onode is not None:
-                    for piece in self._chunks(onode.flush()):
+                    for piece in self._chunks(onode.flush(),
+                                              onode.last_release_count):
                         run_batch(piece)
                 if mp._chain is not None:
                     for out in mp._chain.flush():
@@ -403,6 +438,8 @@ class PipeGraph:
         ``wf/pipegraph.hpp:1058-1105``; our driver is a host push loop)."""
         if self._ended:
             return self._results()
+        if not self._started:
+            self.start()              # resolves batch_size from withBatch hints
         sources = [(mp, mp.source.batches(self.batch_size)) for mp in self._roots]
         live = list(sources)
         round_robin_pos = 0
@@ -420,7 +457,8 @@ class PipeGraph:
         # its Ordering_Node (tuples held back by the low-watermark)
         for mp in self._topo_order():
             if mp._ordering is not None:
-                for piece in self._chunks(mp._ordering.flush()):
+                for piece in self._chunks(mp._ordering.flush(),
+                                          mp._ordering.last_release_count):
                     self._push(mp, piece)
             self._flush_pipe(mp)
         for mp in self._all_pipes():
@@ -451,8 +489,17 @@ class PipeGraph:
     def dump_DOTGraph(self, path: str = None) -> str:
         """Graphviz dump (GRAPHVIZ_WINDFLOW, wf/pipegraph.hpp:226-237,1450-1518)."""
         lines = ["digraph PipeGraph {", "  rankdir=LR;"]
+        def op_label(o):
+            # chained (queue-free fused) ops render bare; routed adds carry
+            # their routing mode — the reference's gv_chain_vertex vs
+            # add-vertex distinction (wf/pipegraph.hpp:1450-1518)
+            if o._chained:
+                return f"{o.getName()} (chained)"
+            mode = o.getRoutingMode().name.lower()
+            return (o.getName() if mode in ("forward", "none")
+                    else f"{o.getName()} ({mode})")
         def label(mp, idx):
-            ops = " | ".join(o.getName() for o in mp.ops) or "(empty)"
+            ops = " | ".join(op_label(o) for o in mp.ops) or "(empty)"
             src = f"{mp.source.getName()} -> " if mp.source else ""
             snk = f" -> {mp.sink.getName()}" if mp.sink else ""
             return f'  mp{idx} [shape=record, label="{src}{ops}{snk}"];'
@@ -535,14 +582,18 @@ class PipeGraph:
             merged._ordering = Ordering_Node(len(merged.merge_inputs), mode)
         return merged._ordering
 
-    def _chunks(self, batch: Optional[Batch]):
+    def _chunks(self, batch: Optional[Batch], n: Optional[int] = None):
         """Compact a released (variable-capacity) batch and re-slice it into
-        batch_size-capacity pieces so downstream chains keep ONE compiled shape."""
+        batch_size-capacity pieces so downstream chains keep ONE compiled shape.
+        ``n`` (the valid-lane count) can be passed by callers that already
+        fetched it — Ordering_Node releases carry ``last_release_count`` — to
+        avoid a second device sync."""
         import numpy as np
         if batch is None:
             return
         b = batch.compact()
-        n = int(np.asarray(jnp.sum(b.valid)))
+        if n is None:
+            n = int(np.asarray(jnp.sum(b.valid)))
         cap = self.batch_size
         for s in range(0, n, cap):
             def cut(a):
@@ -561,9 +612,9 @@ class PipeGraph:
             self._push_split(mp, out)
         for merged in mp._outputs_to:
             if self.mode == Mode.DETERMINISTIC:
-                rel = self._ordering_of(merged).push(
-                    merged.merge_inputs.index(mp), out)
-                for piece in self._chunks(rel):
+                onode = self._ordering_of(merged)
+                rel = onode.push(merged.merge_inputs.index(mp), out)
+                for piece in self._chunks(rel, onode.last_release_count):
                     self._push(merged, piece)
             else:
                 self._push(merged, out)
@@ -679,9 +730,9 @@ class PipeGraph:
             self._exhaust(branch)
         for merged in mp._outputs_to:
             if self.mode == Mode.DETERMINISTIC:
-                rel = self._ordering_of(merged).close_channel(
-                    merged.merge_inputs.index(mp))
-                for piece in self._chunks(rel):
+                onode = self._ordering_of(merged)
+                rel = onode.close_channel(merged.merge_inputs.index(mp))
+                for piece in self._chunks(rel, onode.last_release_count):
                     self._push(merged, piece)
             if all(id(p) in self._exhausted for p in merged.merge_inputs):
                 self._exhaust(merged)

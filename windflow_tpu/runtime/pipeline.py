@@ -31,6 +31,16 @@ from ..operators.sink import ReduceSink, Sink
 from ..operators.source import SourceBase
 
 
+def resolve_batch_hint(ops) -> Optional[int]:
+    """Smallest withBatch hint among ``ops`` (each hint is a per-operator
+    capacity ceiling — reference GPU ``batch_len``, wf/builders_gpu.hpp:115-122 —
+    and a fused chain cannot exceed any member's ceiling); None if no op
+    carries a hint."""
+    hints = [op._batch_hint for op in ops
+             if getattr(op, "_batch_hint", None) is not None]
+    return min(hints) if hints else None
+
+
 def _batch_nbytes(batch: Batch) -> int:
     """Static byte size of a batch from shapes/dtypes (no device access)."""
     total = 0
@@ -52,6 +62,21 @@ class CompiledChain:
                  batch_capacity: int = None):
         self.ops = list(ops)
         self.specs = [in_spec]          # specs[i] = input payload spec of ops[i]
+        if batch_capacity is None:
+            batch_capacity = resolve_batch_hint(self.ops)
+        # withDevice placement (reference withGPU device selection,
+        # wf/builders_gpu.hpp:123-130): the chain is ONE fused program, so one
+        # device per chain — conflicting per-op hints are a build error.
+        devs = {id(op._device): op._device for op in self.ops
+                if getattr(op, "_device", None) is not None}
+        if len(devs) > 1:
+            names = ", ".join(f"{op.getName()}->{op._device}" for op in self.ops
+                              if getattr(op, "_device", None) is not None)
+            raise ValueError(
+                f"conflicting withDevice hints inside one fused chain ({names}); "
+                f"a CompiledChain executes as one XLA program on one device — "
+                f"split the graph at the device boundary")
+        self.device = next(iter(devs.values())) if devs else None
         cap = batch_capacity
         for op in self.ops:
             if cap is not None:
@@ -59,6 +84,8 @@ class CompiledChain:
                 cap = op.out_capacity(cap)
             self.specs.append(op.out_spec(self.specs[-1]))
         self.states = [op.init_state(self.specs[i]) for i, op in enumerate(self.ops)]
+        if self.device is not None:
+            self.states = [jax.device_put(s, self.device) for s in self.states]
         self._steps = {}
 
     def reset_states(self) -> None:
@@ -66,6 +93,8 @@ class CompiledChain:
         that did not exist at the last checkpoint)."""
         self.states = [op.init_state(self.specs[i])
                        for i, op in enumerate(self.ops)]
+        if self.device is not None:
+            self.states = [jax.device_put(s, self.device) for s in self.states]
 
     @property
     def out_spec(self):
@@ -83,6 +112,8 @@ class CompiledChain:
 
     def push(self, batch: Batch, from_op: int = 0) -> Batch:
         """Run one batch through ops[from_op:]; updates states; returns the out batch."""
+        if self.device is not None:
+            batch = jax.device_put(batch, self.device)
         states, out = self._step_fn(from_op)(tuple(self.states), batch)
         self.states = list(states)
         # batch counters are per-op; ops[from_op:] execute as ONE fused compiled
@@ -131,10 +162,13 @@ class Pipeline:
     (SURVEY §7 step 3); MultiPipe builds on this per-segment."""
 
     def __init__(self, source: SourceBase, ops: Sequence[Basic_Operator],
-                 sink: Optional[Sink] = None, *, batch_size: int = DEFAULT_BATCH_SIZE,
-                 prefetch: int = 0):
+                 sink: Optional[Sink] = None, *,
+                 batch_size: Optional[int] = None, prefetch: int = 0):
         self.source = source
         self.sink = sink
+        if batch_size is None:
+            # withBatch hints are capacity ceilings; explicit batch_size wins
+            batch_size = resolve_batch_hint(ops) or DEFAULT_BATCH_SIZE
         self.batch_size = batch_size
         self.prefetch = int(prefetch)   # >0: overlapped host framing + H2D transfers
         chain_ops = list(ops)
