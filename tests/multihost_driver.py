@@ -62,7 +62,10 @@ gen = jax.jit(lambda: (jnp.arange(C, dtype=jnp.int32) * 7 % 13,
                              NamedSharding(mesh, P("key")),
                              NamedSharding(mesh, P("key"))))
 keys, valid, payload = gen()
-out_keys, out_valid, out_pay = exchange(keys, valid, payload)
+out_keys, out_valid, out_pay, n_left = exchange(keys, valid, payload)
+# capacity C: complete exchange (n_left is global — read this process's shards)
+assert all(int(np.asarray(s.data).sum()) == 0
+           for s in n_left.addressable_shards)
 
 # every row landed on the key-axis shard that owns its key (owner = key % 2),
 # with its payload riding along

@@ -116,7 +116,8 @@ def test_keyed_all_to_all_ownership_and_conservation():
     pay = {"v": jnp.arange(C, dtype=jnp.float32),
            "m": jnp.asarray(rng.normal(size=(C, 3)), jnp.float32)}
     f = jax.jit(keyed_all_to_all(mesh, axis="key", capacity=64))
-    rk, rv, rp = f(keys, valid, pay)
+    rk, rv, rp, n_left = f(keys, valid, pay)
+    assert int(np.asarray(n_left).sum()) == 0      # capacity 64 is ample: complete
     rk, rv = np.asarray(rk), np.asarray(rv)
     rv_np = np.asarray(rp["v"])
     # every live row landed on its owner device
@@ -138,16 +139,61 @@ def test_keyed_all_to_all_ownership_and_conservation():
             np.testing.assert_allclose(m[i], src_m[float(rv_np[i])])
 
 
-def test_keyed_all_to_all_overflow_drops_not_corrupts():
+def test_keyed_all_to_all_overflow_is_loud_not_silent():
     mesh = make_mesh(MESH, axis="key")
     C = 16 * MESH
     keys = jnp.zeros(C, jnp.int32)              # all rows -> device 0
     valid = jnp.ones(C, bool)
     pay = {"v": jnp.arange(C, dtype=jnp.float32)}
     f = jax.jit(keyed_all_to_all(mesh, axis="key", capacity=4))
-    rk, rv, rp = f(keys, valid, pay)
+    rk, rv, rp, n_left = f(keys, valid, pay)
     rv = np.asarray(rv).ravel()
     rk = np.asarray(rk)
     # capacity 4 per (src,dst) lane: device 0 receives at most 8*4 live rows
     assert rv.sum() == 4 * MESH
     assert np.all(rk[rv] == 0)
+    # every row NOT delivered is accounted for: 16 live per source, 4 shipped
+    n_left = np.asarray(n_left)
+    assert n_left.shape == (MESH,)
+    assert np.all(n_left == 12), n_left
+    assert int(rv.sum()) + int(n_left.sum()) == C
+
+
+def test_keyed_all_to_all_residue_identifies_left_rows():
+    mesh = make_mesh(MESH, axis="key")
+    C = 16 * MESH
+    keys = jnp.zeros(C, jnp.int32)
+    valid = jnp.ones(C, bool)
+    pay = {"v": jnp.arange(C, dtype=jnp.float32)}
+    f = jax.jit(keyed_all_to_all(mesh, axis="key", capacity=4, return_residue=True))
+    rk, rv, rp, n_left, resid = f(keys, valid, pay)
+    resid = np.asarray(resid)
+    assert resid.shape == (C,)
+    assert resid.sum() == int(np.asarray(n_left).sum())
+    # delivered rows + residue rows partition the live set exactly
+    delivered = sorted(float(v) for v, ok in
+                       zip(np.asarray(rp["v"]).ravel(), np.asarray(rv).ravel()) if ok)
+    left = sorted(float(v) for v, r in zip(np.asarray(pay["v"]), resid) if r)
+    assert sorted(delivered + left) == [float(i) for i in range(C)]
+
+
+def test_keyed_all_to_all_lossless_delivers_everything():
+    from windflow_tpu.parallel.collective import keyed_all_to_all_lossless
+    mesh = make_mesh(MESH, axis="key")
+    C = 16 * MESH
+    rng = np.random.default_rng(7)
+    # skewed keys: one hot key overflows its (src,dst) lane budget repeatedly
+    keys = jnp.asarray(np.where(rng.random(C) < 0.7, 0, rng.integers(0, 29, C)),
+                       jnp.int32)
+    valid = jnp.asarray(rng.random(C) < 0.95)
+    pay = {"v": jnp.arange(C, dtype=jnp.float32)}
+    run = keyed_all_to_all_lossless(mesh, axis="key", capacity=3)
+    rk, rv, rp, n_rounds = run(keys, valid, pay)
+    assert n_rounds > 1                          # the skew actually forced rounds
+    rk, rvm = np.asarray(rk), np.asarray(rv)
+    # the multiset of live (key, v) pairs is fully preserved — nothing dropped
+    want = sorted((int(k), float(v)) for k, v, ok in
+                  zip(np.asarray(keys), np.asarray(pay["v"]), np.asarray(valid)) if ok)
+    got = sorted((int(k), float(v)) for k, v, ok in
+                 zip(rk, np.asarray(rp["v"]), rvm) if ok)
+    assert got == want

@@ -35,7 +35,7 @@ def test_collective_over_ici_axis_of_hybrid_mesh():
     pay = {"v": jnp.arange(C, dtype=jnp.float32)}
     sh = NamedSharding(mesh, P("key"))
     args = jax.tree.map(lambda a: jax.device_put(a, sh), (keys, valid, pay))
-    rk, rv, rp = jax.jit(keyed_all_to_all(mesh, axis="key"))(*args)
+    rk, rv, rp, _ = jax.jit(keyed_all_to_all(mesh, axis="key"))(*args)
     rk, rv = np.asarray(rk), np.asarray(rv).ravel()
     per_dev = rk.shape[0] // 8
     for d in range(8):

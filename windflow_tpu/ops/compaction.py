@@ -50,10 +50,18 @@ def scatter_compact(values: Any, valid: jax.Array, capacity: int = None) -> Tupl
 
 
 def partition_by_destination(dest: jax.Array, valid: jax.Array, n_dest: int,
-                             capacity_per_dest: int):
+                             capacity_per_dest: int, return_counts: bool = False):
     """Group lanes by destination: returns (gather_idx ``[n_dest, cap]``, out_valid
     ``[n_dest, cap]``). The device-side counterpart of the GPU keyed-scatter emitter
-    building per-destination sub-batches (``wf/standard_nodes_gpu.hpp:60-238``)."""
+    building per-destination sub-batches (``wf/standard_nodes_gpu.hpp:60-238``).
+
+    A destination with more than ``capacity_per_dest`` live lanes overflows: the
+    overflowing lanes are NOT in the gather table. With ``return_counts=True`` the
+    UNCLAMPED per-destination live counts ``[n_dest]`` are returned as a third value
+    so the caller can detect overflow (``counts > capacity_per_dest``) and re-route
+    the residue — the bounded-queue backpressure discipline of the reference
+    (``FF_BOUNDED_BUFFER`` blocks, it never drops). :class:`~..parallel.emitters.
+    Standard_Emitter` uses this to make routing lossless."""
     c = dest.shape[0]
     # out-of-range destinations (a user routing_func may return anything,
     # including negatives, which would sort BEFORE bucket 0 and shift every
@@ -69,11 +77,14 @@ def partition_by_destination(dest: jax.Array, valid: jax.Array, n_dest: int,
     gather_idx = offsets[:, None] + lane[None, :]
     out_valid = lane[None, :] < counts[:, None]
     gather_idx = jnp.clip(gather_idx, 0, c - 1)
+    if return_counts:
+        return jnp.take(order, gather_idx), out_valid, counts
     return jnp.take(order, gather_idx), out_valid
 
 
 def partition_by_destination_onehot(dest: jax.Array, valid: jax.Array,
-                                    n_dest: int, capacity_per_dest: int):
+                                    n_dest: int, capacity_per_dest: int,
+                                    return_counts: bool = False):
     """Sort-free variant of :func:`partition_by_destination` for SMALL fan-out:
     each lane's within-destination rank comes from a one-hot cumsum ([C, D]
     sequential-memory traffic instead of the sort network's log^2 passes), then
@@ -101,4 +112,6 @@ def partition_by_destination_onehot(dest: jax.Array, valid: jax.Array,
                   .reshape(n_dest, cap))
     lane = jnp.arange(cap, dtype=jnp.int32)
     out_valid = lane[None, :] < jnp.minimum(counts, cap)[:, None]
+    if return_counts:
+        return gather_idx, out_valid, counts
     return gather_idx, out_valid

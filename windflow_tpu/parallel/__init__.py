@@ -3,7 +3,8 @@ from .sharding import ShardedChain, shard_batch, batch_sharding
 from .emitters import (Basic_Emitter, Standard_Emitter, Broadcast_Emitter,
                        Splitting_Emitter, Tree_Emitter)
 from .ordering import Ordering_Node
-from .collective import wmr_map_reduce, ring_pane_windows, keyed_all_to_all
+from .collective import (wmr_map_reduce, ring_pane_windows, keyed_all_to_all,
+                         keyed_all_to_all_lossless)
 from . import multihost
 
 __all__ = [
@@ -11,5 +12,6 @@ __all__ = [
     "ShardedChain", "shard_batch", "batch_sharding",
     "Basic_Emitter", "Standard_Emitter", "Broadcast_Emitter",
     "Splitting_Emitter", "Tree_Emitter", "Ordering_Node",
-    "wmr_map_reduce", "ring_pane_windows", "keyed_all_to_all", "multihost",
+    "wmr_map_reduce", "ring_pane_windows", "keyed_all_to_all",
+    "keyed_all_to_all_lossless", "multihost",
 ]

@@ -176,22 +176,37 @@ def ring_pane_windows(combine: Callable, identity, mesh: Mesh, *,
 
 # -- keyed all-to-all ------------------------------------------------------------------
 
-def keyed_all_to_all(mesh: Mesh, *, axis: str = "key", capacity: int | None = None):
+def keyed_all_to_all(mesh: Mesh, *, axis: str = "key", capacity: int | None = None,
+                     return_residue: bool = False):
     """Build ``f(keys [C], valid [C], payload pytree of [C, ...]) ->
-    (keys, valid, payload)`` redistributing every live row to the device that owns
-    its key (owner = key % n_devices), over one ``lax.all_to_all``.
+    (keys, valid, payload, n_left_behind)`` redistributing every live row to the
+    device that owns its key (owner = key % n_devices), over one ``lax.all_to_all``.
 
     Per (source, destination) lane budget is ``capacity`` rows (default C // p);
     each source compacts its rows per destination into [p, capacity] sub-batches
     (the ``create_sub_batch`` compaction of ``wf/standard_nodes_gpu.hpp``, done with
     a rank-within-destination scatter), exchanges, and flattens back to a [p*cap]
-    local batch with a validity mask. Overflowing rows beyond the lane budget are
-    dropped — size the capacity like any bounded queue (backpressure discipline)."""
+    local batch with a validity mask.
+
+    **Nothing is silently lost.** Rows beyond a lane budget stay on their source and
+    are reported in ``n_left_behind`` — a per-source [p] i32 count (all zeros ⇒ the
+    exchange was complete; with ``capacity = C`` overflow is impossible). With
+    ``return_residue=True`` the per-row residue mask [global C] is also returned so
+    the caller can re-run the exchange on exactly the rows left behind —
+    :func:`keyed_all_to_all_lossless` wraps that into the multi-round blocking
+    discipline of the reference's bounded queues (``FF_BOUNDED_BUFFER`` blocks; it
+    never drops)."""
     p = _axis_size(mesh, axis)
 
     def local(keys, valid, payload):
         C = keys.shape[0]
         cap = capacity if capacity is not None else C // p
+        if cap < 1:
+            raise ValueError(
+                f"keyed_all_to_all: per-(src,dst) lane capacity resolved to "
+                f"{cap} (local rows {C}, devices {p}) — no row could ever be "
+                f"delivered and the lossless wrapper would loop forever; pass "
+                f"an explicit capacity >= 1")
         dest = jnp.where(valid, keys % p, p)            # p = parked lane
         # rank of each row among live rows with the same destination (stream order)
         rank = segment_rank(dest, valid)
@@ -213,7 +228,44 @@ def keyed_all_to_all(mesh: Mesh, *, axis: str = "key", capacity: int | None = No
         rk, rv = ex(sub_keys), ex(sub_valid)
         rp = jax.tree.map(ex, sub_pay)
         flat = lambda a: a.reshape((p * cap,) + a.shape[2:])
-        return flat(rk), flat(rv), jax.tree.map(flat, rp)
+        residue = valid & ~slot_ok                       # live rows left behind
+        n_left = jnp.sum(residue.astype(jnp.int32)).reshape(1)
+        out = (flat(rk), flat(rv), jax.tree.map(flat, rp), n_left)
+        return out + (residue,) if return_residue else out
 
+    specs = (P(axis), P(axis), P(axis), P(axis))
+    if return_residue:
+        specs = specs + (P(axis),)
     return _shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
-                      out_specs=(P(axis), P(axis), P(axis)))
+                      out_specs=specs)
+
+
+def keyed_all_to_all_lossless(mesh: Mesh, *, axis: str = "key",
+                              capacity: int | None = None):
+    """Multi-round :func:`keyed_all_to_all` that delivers EVERY live row: rounds of
+    exchange run until no source reports rows left behind, and each receiver's
+    rounds are concatenated along the batch axis. The host loop is the blocking
+    backpressure of the reference's bounded queues — later rounds are the emitter
+    thread blocking on a full ``FF_BOUNDED_BUFFER`` until the consumer drains it.
+    The round count is identical on every process (it is driven by the summed
+    left-behind counts, which all processes compute), so the loop is safe under
+    multi-controller execution. Returns ``(keys, valid, payload, n_rounds)``."""
+    ex = jax.jit(keyed_all_to_all(mesh, axis=axis, capacity=capacity,
+                                  return_residue=True))
+
+    def run(keys, valid, payload):
+        outs = []
+        v = valid
+        while True:
+            rk, rv, rp, n_left, resid = ex(keys, v, payload)
+            outs.append((rk, rv, rp))
+            if int(jnp.sum(n_left)) == 0:
+                break
+            v = resid
+        cat = lambda parts: jnp.concatenate(parts, axis=0)
+        ks = cat([o[0] for o in outs])
+        vs = cat([o[1] for o in outs])
+        ps = jax.tree.map(lambda *ls: cat(list(ls)), *[o[2] for o in outs])
+        return ks, vs, ps, len(outs)
+
+    return run

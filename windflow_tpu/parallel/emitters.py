@@ -25,8 +25,22 @@ import jax
 import jax.numpy as jnp
 
 from ..basic import routing_modes_t
-from ..batch import Batch, tuple_refs
+from ..batch import Batch, concat_batches, tuple_refs
 from ..ops.compaction import partition_by_destination
+
+
+def _pad_batch_pow2(b: Batch) -> Batch:
+    """Pad a batch's capacity up to the next power of two with invalid lanes."""
+    C = b.capacity
+    P = 1
+    while P < C:
+        P *= 2
+    if P == C:
+        return b
+    pad = P - C
+    pz = lambda a: jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+    return Batch(key=pz(b.key), id=pz(b.id), ts=pz(b.ts),
+                 payload=jax.tree.map(pz, b.payload), valid=pz(b.valid))
 
 
 class Basic_Emitter:
@@ -48,6 +62,14 @@ class Basic_Emitter:
 
 
 class Standard_Emitter(Basic_Emitter):
+    """FORWARD / KEYBY routing. KEYBY is LOSSLESS even when ``capacity_per_dest``
+    is smaller than a destination's share of one batch: overflowing lanes are
+    re-partitioned in further passes and each destination receives the rounds
+    concatenated into one sub-batch — the host loop is the blocking bounded-queue
+    backpressure of the reference (``FF_BOUNDED_BUFFER``, ``wf/standard_emitter.
+    hpp:42-132``: the reference blocks, it never drops). ``overflow_rounds``
+    counts the extra passes (0 on the fast path, which also does no host sync)."""
+
     def __init__(self, n_dest: int, mode: routing_modes_t = routing_modes_t.FORWARD,
                  routing_func: Callable = None, capacity_per_dest: int = None,
                  partition: str = "sort"):
@@ -63,20 +85,52 @@ class Standard_Emitter(Basic_Emitter):
                              f"'onehot', got {partition!r}")
         self.partition = partition
         self._rr = 0
+        self.overflow_rounds = 0
         self._jit_part = jax.jit(self._partition, static_argnums=(1,))
+        self._jit_part_resid = jax.jit(self._partition_resid, static_argnums=(1,))
+
+    def _dest(self, batch: Batch) -> jax.Array:
+        return self.routing_func(batch.key, self.n_dest).astype(jnp.int32)
 
     def _partition(self, batch: Batch, cap: int):
         from ..ops.compaction import partition_by_destination_onehot
         part = (partition_by_destination_onehot if self.partition == "onehot"
                 else partition_by_destination)
-        dest = self.routing_func(batch.key, self.n_dest).astype(jnp.int32)
-        idx, ov = part(dest, batch.valid, self.n_dest, cap)
+        idx, ov = part(self._dest(batch), batch.valid, self.n_dest, cap)
         return [batch.select(idx[d], ov[d]) for d in range(self.n_dest)]
+
+    def _partition_resid(self, batch: Batch, cap: int):
+        """Partition + residue: lanes whose within-destination rank exceeds the
+        lane budget stay valid in the returned residue mask for the next pass."""
+        from ..ops.segment import segment_rank
+        subs = self._partition(batch, cap)
+        dest = self._dest(batch)
+        in_range = (dest >= 0) & (dest < self.n_dest)
+        rank = segment_rank(jnp.where(batch.valid & in_range, dest, self.n_dest),
+                            batch.valid)
+        resid = batch.valid & in_range & (rank >= cap)
+        return subs, resid, jnp.sum(resid.astype(jnp.int32))
 
     def route(self, batch: Batch) -> List[Optional[Batch]]:
         if self.mode == routing_modes_t.KEYBY:
             cap = self.capacity_per_dest or batch.capacity
-            return self._jit_part(batch, cap)
+            if cap >= batch.capacity:      # overflow impossible: no sync, one pass
+                return self._jit_part(batch, cap)
+            outs, cur = None, batch
+            while True:
+                subs, resid, n_resid = self._jit_part_resid(cur, cap)
+                outs = (subs if outs is None else
+                        [concat_batches(a, b) for a, b in zip(outs, subs)])
+                if int(n_resid) == 0:
+                    if outs and outs[0].capacity > cap:   # multi-round concat
+                        # pad multi-round outputs to a pow2 capacity so a
+                        # downstream compiled consumer sees O(log rounds)
+                        # distinct shapes, not one per round count (the same
+                        # discipline as Ordering_Node._pad_pow2)
+                        outs = [_pad_batch_pow2(b) for b in outs]
+                    return outs
+                self.overflow_rounds += 1
+                cur = cur.replace(valid=resid)
         # FORWARD: round-robin whole batches (reference sends tuples round-robin;
         # batch granularity keeps device work contiguous)
         out = [None] * self.n_dest
