@@ -42,6 +42,31 @@ def test_unpack_pack_roundtrip_all_field_widths():
     assert np.array_equal(back, rec)
 
 
+@pytest.mark.parametrize("workers", [1, 2, 3, 8])
+def test_parallel_unpack_matches_single_pass(workers):
+    """Sharded framing (parallel_unpack): identical columns to the single native
+    pass for every worker count, including worker counts that don't divide the
+    row count and structured subdtype fields."""
+    from windflow_tpu.native import parallel_unpack
+    rec = make_records(1001)
+    want = unpack_records(rec)
+    got = parallel_unpack(rec, workers=workers)
+    assert set(got) == set(want)
+    for f in want:
+        assert got[f].shape == want[f].shape
+        assert (got[f] == want[f]).all(), f
+
+
+def test_parallel_unpack_tiny_and_empty():
+    from windflow_tpu.native import parallel_unpack
+    for n in (0, 1, 3):
+        rec = make_records(max(n, 1))[:n]
+        got = parallel_unpack(np.ascontiguousarray(rec), workers=4)
+        want = unpack_records(np.ascontiguousarray(rec))
+        for f in want:
+            assert (got[f] == want[f]).all()
+
+
 def test_unpack_noncontiguous_falls_back():
     rec = make_records(200)[::2]                # strided view
     cols = unpack_records(rec)

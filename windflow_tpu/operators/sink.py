@@ -59,7 +59,14 @@ class Sink(Basic_Operator):
 
     def _deliver_host(self, host: Batch):
         v = host.valid
-        if not v.any():
+        # the whole batch crossed device->host to get here: count the transfer
+        # (wf/stats_record.hpp:78-80 bytes_copied_dh) + live-tuple ingress
+        rec = self._stats[0]
+        rec.bytes_copied_dh += sum(
+            a.nbytes for a in jax.tree.leaves(host) if hasattr(a, "nbytes"))
+        n_live = int(v.sum())
+        rec.record_input(n_live)
+        if not n_live:
             return
         self._deliver({
             "key": host.key[v], "id": host.id[v], "ts": host.ts[v],

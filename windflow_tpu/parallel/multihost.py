@@ -48,9 +48,12 @@ def initialize(coordinator_address: Optional[str] = None,
     ``jax.process_count()`` — querying the backend would itself initialize it,
     after which ``jax.distributed.initialize`` is too late (2-process smoke
     test caught exactly that)."""
-    from jax._src import distributed as _dist
-    if getattr(_dist.global_state, "client", None) is not None:
-        return False                              # already initialized
+    try:                                          # private module path: may move
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return False                          # already initialized
+    except (ImportError, AttributeError):
+        pass  # fall through: initialize() below raises if already initialized
     if coordinator_address is None and num_processes is None:
         import os
         if "JAX_COORDINATOR_ADDRESS" not in os.environ:

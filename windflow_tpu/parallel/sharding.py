@@ -94,6 +94,14 @@ class ShardedChain:
 
     def __init__(self, chain: CompiledChain, mesh: Mesh, axis: str = "dp",
                  win_axis: Optional[str] = None, key_axis: Optional[str] = None):
+        # validate axis names up front: a typo would otherwise surface as a bare
+        # KeyError from inside jax.tree.map during device_put
+        for name, val in (("axis", axis), ("win_axis", win_axis),
+                          ("key_axis", key_axis)):
+            if val is not None and val not in mesh.axis_names:
+                raise ValueError(
+                    f"ShardedChain: {name}={val!r} is not an axis of the mesh "
+                    f"(axes: {tuple(mesh.axis_names)})")
         self.chain = chain
         self.mesh = mesh
         self.axis = axis

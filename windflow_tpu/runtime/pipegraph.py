@@ -406,9 +406,11 @@ class PipeGraph:
                 propagate_eos(mp)
 
         def source_body(mp):
+            from .pipeline import record_source_launch
             q = out_edges[("src", id(mp))]
             try:
                 for batch in mp.source.batches(self.batch_size):
+                    record_source_launch(mp.source, batch)
                     q.push(batch)
             except BaseException as e:          # noqa: BLE001
                 errors.append(e)
@@ -440,6 +442,7 @@ class PipeGraph:
             return self._results()
         if not self._started:
             self.start()              # resolves batch_size from withBatch hints
+        from .pipeline import record_source_launch
         sources = [(mp, mp.source.batches(self.batch_size)) for mp in self._roots]
         live = list(sources)
         round_robin_pos = 0
@@ -453,6 +456,7 @@ class PipeGraph:
                 continue
             self._push(mp, batch)
             round_robin_pos += 1
+            record_source_launch(mp.source, batch)
         # EOS: flush every pipe in topological order; a merged pipe first drains
         # its Ordering_Node (tuples held back by the low-watermark)
         for mp in self._topo_order():

@@ -41,6 +41,16 @@ def resolve_batch_hint(ops) -> Optional[int]:
     return min(hints) if hints else None
 
 
+def record_source_launch(source, batch: Batch) -> None:
+    """Per-batch source-side stats: one launch + the H2D bytes the framed batch
+    cost (a DeviceSource generates inside the compiled program — zero
+    transfer). The SINGLE place H2D bytes are counted (wf/stats_record.hpp:
+    76-80); every driver loop calls this as it pulls a batch from a source."""
+    from ..operators.source import DeviceSource
+    source.get_StatsRecords()[0].record_launch(
+        hd_bytes=0 if isinstance(source, DeviceSource) else _batch_nbytes(batch))
+
+
 def _batch_nbytes(batch: Batch) -> int:
     """Static byte size of a batch from shapes/dtypes (no device access)."""
     total = 0
@@ -57,6 +67,12 @@ class CompiledChain:
 
     ``step_from(i)`` runs ops[i:] — used both for the main path (i=0) and for EOS
     flush cascades starting after operator i."""
+
+    #: every Nth push is timed dispatch->completion (block_until_ready) and the
+    #: sample recorded as the entry op's service time (wf/stats_record.hpp:76-80
+    #: tracks per-svc service time; sampling keeps the async overlap intact on
+    #: the other N-1 pushes)
+    SERVICE_SAMPLE_EVERY = 16
 
     def __init__(self, ops: Sequence[Basic_Operator], in_spec: Any,
                  batch_capacity: int = None):
@@ -87,6 +103,7 @@ class CompiledChain:
         if self.device is not None:
             self.states = [jax.device_put(s, self.device) for s in self.states]
         self._steps = {}
+        self._push_count = 0
 
     def reset_states(self) -> None:
         """Re-initialize every operator's state (supervised replay of a chain
@@ -112,9 +129,20 @@ class CompiledChain:
 
     def push(self, batch: Batch, from_op: int = 0) -> Batch:
         """Run one batch through ops[from_op:]; updates states; returns the out batch."""
+        import time
         if self.device is not None:
             batch = jax.device_put(batch, self.device)
+        self._push_count += 1
+        # never sample push #1 — it would time JIT trace + XLA compile, not
+        # service; the first sample lands at push SERVICE_SAMPLE_EVERY
+        sampled = (self._push_count % self.SERVICE_SAMPLE_EVERY) == 0
+        t0 = time.perf_counter() if sampled else 0.0
         states, out = self._step_fn(from_op)(tuple(self.states), batch)
+        if sampled:
+            jax.block_until_ready(out)
+            service_s = time.perf_counter() - t0
+        else:
+            service_s = None
         self.states = list(states)
         # batch counters are per-op; ops[from_op:] execute as ONE fused compiled
         # program, so num_kernels counts ONE launch, attributed to the entry op
@@ -130,7 +158,10 @@ class CompiledChain:
             rec.bytes_received += in_bytes
             rec.bytes_sent += out_bytes
         if self.ops:
-            self.ops[from_op].get_StatsRecords()[0].num_kernels += 1
+            # H2D bytes are counted ONCE, at the source that framed the batch
+            # (Pipeline.run / pipegraph source loops) — counting the possible
+            # device_put above too would double-count the same transfer
+            self.ops[from_op].get_StatsRecords()[0].record_launch(service_s)
         return out
 
     def flush(self) -> List[Batch]:
@@ -177,12 +208,11 @@ class Pipeline:
                                    batch_capacity=cap)
 
     def run(self):
-        stats = self.source.get_StatsRecords()[0]
         batches = (self.source.batches_prefetched(self.batch_size, self.prefetch)
                    if self.prefetch else self.source.batches(self.batch_size))
         for batch in batches:
+            record_source_launch(self.source, batch)
             out = self.chain.push(batch)
-            stats.record_launch()
             if self.sink is not None:
                 self.sink.consume(out)
         for out in self.chain.flush():

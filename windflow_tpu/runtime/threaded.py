@@ -58,8 +58,10 @@ class ThreadedPipeline:
     def _source_body(self, core: int):
         if self.pin:
             pin_thread(core)
+        from .pipeline import record_source_launch
         try:
             for batch in self.source.batches(self.batch_size):
+                record_source_launch(self.source, batch)
                 self.queues[0].push(batch)
         except BaseException as e:          # noqa: BLE001 — propagated to join
             self._errors.append(e)

@@ -45,12 +45,18 @@ class Stats_Record:
         self.bytes_sent += int(n_bytes)
         self.batches_sent += 1
 
-    def record_launch(self, service_time_s: float = 0.0, hd_bytes: int = 0, dh_bytes: int = 0):
+    def record_launch(self, service_time_s: float = None, hd_bytes: int = 0,
+                      dh_bytes: int = 0):
+        """One compiled-program launch. ``service_time_s`` is a MEASURED
+        dispatch->completion sample (the chain samples every Nth push with a
+        block_until_ready so the async pipeline stays overlapped); pass None on
+        unsampled launches — only real samples enter the average."""
         self.num_kernels += 1
         self.bytes_copied_hd += int(hd_bytes)
         self.bytes_copied_dh += int(dh_bytes)
-        self._service_time_sum += service_time_s
-        self._service_samples += 1
+        if service_time_s is not None:
+            self._service_time_sum += float(service_time_s)
+            self._service_samples += 1
 
     @property
     def avg_service_time_us(self) -> float:
