@@ -52,6 +52,11 @@ def _device_fingerprint() -> str:
     try:
         from jax._src import xla_bridge
         if not xla_bridge._backends:          # nothing initialized yet
+            # a CPU-pinned process can't hang on the tunnel: initializing the
+            # backend for the fingerprint is safe (fixes the r04 capture that
+            # stamped itself "unknown (no backend initialized)")
+            if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+                return str(mod.devices()[0])
             return "unknown (no backend initialized)"
         return str(mod.devices()[0])          # cached list — no device I/O
     except Exception:  # noqa: BLE001 — fingerprinting must never kill a capture
@@ -116,6 +121,55 @@ def emit_stale_headline(diagnostic: str) -> int:
     return 0
 
 
+# Roofline peaks: overridable because the fingerprint string does not encode
+# the SKU's datasheet. Defaults = TPU v5e (819 GB/s HBM, 197 bf16 TFLOP/s).
+HBM_PEAK_GBPS = float(os.environ.get("WF_HBM_PEAK_GBPS", 819))
+PEAK_TFLOPS = float(os.environ.get("WF_PEAK_TFLOPS", 197))
+
+
+def _arg_specs(args):
+    """ShapeDtypeStruct skeleton of ``args`` — captured BEFORE a donating loop
+    runs (metadata only), usable for lowering AFTER it."""
+    import jax
+    return jax.tree.map(
+        lambda a: (jax.ShapeDtypeStruct(a.shape, a.dtype)
+                   if hasattr(a, "shape") else a), args)
+
+
+def _roofline(step_jitted, args, step_s):
+    """Roofline utilization for one compiled step (VERDICT r05 ask #7):
+    XLA's own cost model (``compiled.cost_analysis()``) supplies bytes
+    accessed + FLOPs per step; divided by the measured step time and the
+    device peaks that yields achieved GB/s / GFLOP/s and utilization
+    percentages — "device-bound" as a number, not prose.
+
+    Called AFTER the timed loop (with ``_arg_specs`` captured beforehand): the
+    AOT lower().compile() needed to read the cost model is a second compile of
+    the same program, and on the flaky tunneled link that must not sit between
+    the healthcheck and the measurement — if the link dies here, the
+    throughput number has already landed."""
+    try:
+        compiled = step_jitted.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        bts = float(ca.get("bytes accessed", 0.0))
+    except Exception as e:  # noqa: BLE001 — cost model is backend-dependent
+        return {"error": f"cost_analysis unavailable: {e}"}
+    gbps = bts / step_s / 1e9
+    gfls = flops / step_s / 1e9
+    return {
+        "bytes_per_step": bts,
+        "flops_per_step": flops,
+        "achieved_hbm_gbps": round(gbps, 2),
+        "hbm_utilization_pct": round(100 * gbps / HBM_PEAK_GBPS, 2),
+        "achieved_gflops": round(gfls, 2),
+        "mxu_utilization_pct": round(100 * gfls / (PEAK_TFLOPS * 1e3), 3),
+        "peaks": {"hbm_gbps": HBM_PEAK_GBPS, "tflops": PEAK_TFLOPS},
+    }
+
+
 def _bench_loop(step, states, n_steps, batch, reps: int = 1):
     """Time ``n_steps`` async-dispatched steps; with ``reps`` > 1 return the
     median rep (dispatch-pipelining jitter on the tunneled link is large when
@@ -159,8 +213,10 @@ def bench_ysb():
         return tuple(states), batch.valid
 
     step = jax.jit(step, donate_argnums=0)
+    specs = _arg_specs((tuple(chain.states), 0))
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH)
-    return STEPS * BATCH / dt, dt / STEPS
+    roof = _roofline(step, specs, dt / STEPS)
+    return STEPS * BATCH / dt, dt / STEPS, roof
 
 
 def bench_stateless():
@@ -188,8 +244,10 @@ def bench_stateless():
         return tuple(states), batch.valid
 
     step = jax.jit(step, donate_argnums=0)
+    specs = _arg_specs((tuple(chain.states), 0))
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH)
-    return STEPS * BATCH / dt, dt / STEPS
+    roof = _roofline(step, specs, dt / STEPS)
+    return STEPS * BATCH / dt, dt / STEPS, roof
 
 
 def bench_keyed_cb():
@@ -217,8 +275,10 @@ def bench_keyed_cb():
         return tuple(states), batch.valid
 
     step = jax.jit(step, donate_argnums=0)
+    specs = _arg_specs((tuple(chain.states), 0))
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH, reps=reps)
-    return STEPS * BATCH / dt, dt / STEPS
+    roof = _roofline(step, specs, dt / STEPS)
+    return STEPS * BATCH / dt, dt / STEPS, roof
 
 
 def measure_floor():
@@ -423,7 +483,11 @@ def bench_ordering_overhead(total: int = 200_000, batch: int = 4096):
         dt = time.perf_counter() - t0
         return 2 * total / dt, float(res["out"])
 
-    run(Mode.DEFAULT)                       # warm compile caches
+    # warm BOTH modes' compile caches (the Ordering_Node's jitted cores are
+    # module-level and shared across instances, so a warmup graph's traces
+    # carry over to the timed run)
+    run(Mode.DEFAULT)
+    run(Mode.DETERMINISTIC)
     d_tps, d_sum = run(Mode.DEFAULT)
     o_tps, o_sum = run(Mode.DETERMINISTIC)
     assert d_sum == o_sum, (d_sum, o_sum)   # ordering must not change the sum
@@ -555,6 +619,108 @@ def bench_ingest_decomposition(n: int = 1 << 20, reps: int = 7):
         "transfer_tps": xfer_tps,
         "ingest_ceiling_tps": min(framing_tps, xfer_tps),
     }
+
+
+def bench_drive_loop(batches=(1024, 4096, 16384, 262144, 1 << 20),
+                     total_tuples: int = 1 << 22):
+    """Host-side cost of the Python drive loop, per batch (VERDICT r05 ask #5).
+
+    Every fresh PipeGraph re-traces its user lambdas, so timing one run times
+    compilation. Instead each batch size runs the SAME graph shape at two
+    stream lengths N1 < N2: both pay the identical compile cost C, so the
+    steady-state per-batch driver wall time is (t2-t1)/(N2-N1), compile
+    cancelled. Subtracting the bare pre-jitted step loop's per-batch time
+    (device dispatch only, measured warm) leaves ``driver_us_per_batch`` — the
+    Python loop's own cost. Rows feed BASELINE.md's decision on moving the
+    steady-state loop behind the native layer (SURVEY §7: Python as toolchain,
+    not data path)."""
+    import jax
+    import jax.numpy as jnp
+    import windflow_tpu as wf
+    from windflow_tpu.operators.source import DeviceSource
+    from windflow_tpu.runtime.pipeline import CompiledChain
+    from windflow_tpu.runtime.pipegraph import PipeGraph
+
+    rows = []
+    for B in batches:
+        n1 = max(total_tuples // B // 4, 4)
+        n2 = max(total_tuples // B, 4 * n1)
+
+        def run_graph(n_batches):
+            g = PipeGraph("drv", batch_size=B)
+            (g.add_source(wf.Source(lambda i: {"v": (i % 97).astype(jnp.float32)},
+                                    total=n_batches * B, num_keys=8))
+             .add(wf.Map(lambda t: {"v": t.v * 2.0 + 1.0}))
+             .add(wf.ReduceSink(lambda t: t.v, name="out")))
+            t0 = time.perf_counter()
+            g.run()
+            return time.perf_counter() - t0
+
+        run_graph(n1)                         # warm persistent XLA caches
+        t1 = min(run_graph(n1) for _ in range(2))
+        t2 = min(run_graph(n2) for _ in range(2))
+        per_batch_s = max(t2 - t1, 0.0) / (n2 - n1)
+
+        # bare loop: same ops, pre-jitted, no driver
+        src = DeviceSource(lambda i: {"v": (i % 97).astype(jnp.float32)},
+                           total=(n2 + 2) * B, num_keys=8)
+        ops = [wf.Map(lambda t: {"v": t.v * 2.0 + 1.0}),
+               wf.ReduceSink(lambda t: t.v, name="out")]
+        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=B)
+
+        def step(states, start):
+            b = src.make_batch(jnp.asarray(start, jnp.int32), B)
+            states = list(states)
+            for j, op in enumerate(chain.ops):
+                states[j], b = op.apply(states[j], b)
+            return tuple(states), b.valid
+        step = jax.jit(step, donate_argnums=0)
+        bare_s, _ = _bench_loop(step, tuple(chain.states), n2 - n1, B)
+
+        step_us = bare_s / (n2 - n1) * 1e6
+        drv_us = per_batch_s * 1e6 - step_us
+        rows.append({
+            "batch": B, "n1": n1, "n2": n2,
+            "driver_wall_us_per_batch": round(per_batch_s * 1e6, 1),
+            "step_us_per_batch": round(step_us, 1),
+            "driver_us_per_batch": round(max(drv_us, 0.0), 1),
+            "driver_overhead_pct": round(100 * max(drv_us, 0.0)
+                                         / max(step_us, 1e-9), 1),
+        })
+    return rows
+
+
+def bench_framing_scaling(n: int = 1 << 22, workers=(1, 2, 4, 8), reps: int = 5):
+    """Multi-core host framing sweep (VERDICT r05 ask #6): sharded AoS->SoA
+    transpose (``parallel_unpack``) vs worker count — the reference's 1-14
+    source-thread sweep applied to framing. On a single-core container the
+    curve is flat by construction; the row set records the container's core
+    count so the number reads honestly."""
+    import numpy as np
+    from windflow_tpu.native import (hardware_concurrency, native_available,
+                                     parallel_unpack)
+
+    rec_dt = np.dtype([("ad_id", "<i4"), ("event_type", "<i4"), ("ts", "<i4")])
+    rng = np.random.default_rng(5)
+    buf = np.empty(n, rec_dt)
+    buf["ad_id"] = rng.integers(0, 100000, n, dtype=np.int32)
+    buf["event_type"] = rng.integers(0, 3, n, dtype=np.int32)
+    buf["ts"] = np.arange(n, dtype=np.int32)
+
+    rows = []
+    for w in workers:
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            parallel_unpack(buf, workers=w)
+            ts.append(time.perf_counter() - t0)
+        dt = sorted(ts)[len(ts) // 2]
+        rows.append({"workers": w, "ns_per_tuple": round(dt / n * 1e9, 2),
+                     "tps": round(n / dt), "gbps": round(buf.nbytes / dt / 1e9, 2)})
+    return {"native": bool(native_available()),
+            "host_cores": hardware_concurrency(),
+            "rows": rows,
+            "speedup_at_max": round(rows[-1]["tps"] / rows[0]["tps"], 2)}
 
 
 def bench_pallas_ab(shapes=((4096, 512), (1024, 1024), (8192, 256)),
@@ -704,13 +870,20 @@ def main():
     # crashing: the tunnel dying MID-run must not erase a fresh YSB number
     # (it erased the whole r03 capture).
     try:
-        ysb_tps, ysb_step_s = bench_ysb()
+        ysb_tps, ysb_step_s, ysb_roof = bench_ysb()
     except Exception as e:  # noqa: BLE001 — device death mid-run
         import traceback
         traceback.print_exc()
         sys.exit(emit_stale_headline(
             f"bench_ysb failed after a passing healthcheck: {e}"))
-    record("ysb", {"tps": ysb_tps, "step_s": ysb_step_s, "batch": BATCH})
+    record("ysb", {"tps": ysb_tps, "step_s": ysb_step_s, "batch": BATCH,
+                   "roofline": ysb_roof})
+    if "error" not in ysb_roof:
+        print(f"YSB roofline: {ysb_roof['achieved_hbm_gbps']} GB/s HBM "
+              f"({ysb_roof['hbm_utilization_pct']}% of peak), "
+              f"{ysb_roof['achieved_gflops']} GFLOP/s "
+              f"({ysb_roof['mxu_utilization_pct']}% of MXU peak)",
+              file=sys.stderr)
     headline = {
         "metric": "YSB tuples/sec/chip",
         "value": round(ysb_tps),
@@ -729,14 +902,16 @@ def main():
 
 
 def _secondary_benches(ysb_tps, ysb_step_s):
-    sl_tps, sl_step_s = bench_stateless()
-    record("stateless", {"tps": sl_tps, "step_s": sl_step_s, "batch": BATCH})
+    sl_tps, sl_step_s, sl_roof = bench_stateless()
+    record("stateless", {"tps": sl_tps, "step_s": sl_step_s, "batch": BATCH,
+                         "roofline": sl_roof})
     print(f"YSB: {ysb_tps/1e6:.2f} M tuples/s ({ysb_step_s*1e3:.2f} ms/step, "
           f"batch={BATCH})", file=sys.stderr)
     print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "
-          f"({sl_step_s*1e3:.2f} ms/step)", file=sys.stderr)
-    kc_tps, kc_step = _run_isolated("bench_keyed_cb()")
-    record("keyed_cb", {"tps": kc_tps, "step_s": kc_step},
+          f"({sl_step_s*1e3:.2f} ms/step; roofline "
+          f"{sl_roof.get('hbm_utilization_pct', '?')}% HBM)", file=sys.stderr)
+    kc_tps, kc_step, kc_roof = _run_isolated("bench_keyed_cb()")
+    record("keyed_cb", {"tps": kc_tps, "step_s": kc_step, "roofline": kc_roof},
            methodology="isolated-subprocess")
     print(f"keyed CB sliding windows (K=512, w=1024 s=512): "
           f"{kc_tps/1e6:.2f} M tuples/s ({kc_step*1e3:.2f} ms/step)",
@@ -800,6 +975,21 @@ def _secondary_benches(ysb_tps, ysb_step_s):
                methodology="isolated-subprocess")
         dec = _run_isolated("bench_ingest_decomposition()")
         record("ingest_decomposition", dec, methodology="isolated-subprocess")
+        fs = _run_isolated("bench_framing_scaling()")
+        record("framing_scaling", fs, methodology="isolated-subprocess")
+        print(f"host framing scaling ({fs['host_cores']} core(s)): " +
+              ", ".join(f"{r['workers']}w={r['tps']/1e6:.0f}M t/s"
+                        for r in fs["rows"]) +
+              f" (speedup {fs['speedup_at_max']}x; flat on a 1-core container)",
+              file=sys.stderr)
+        dl = _run_isolated("bench_drive_loop()")
+        record("drive_loop", {"rows": dl}, methodology="isolated-subprocess")
+        print("Python drive-loop cost (driver-vs-bare, per batch):",
+              file=sys.stderr)
+        for r in dl:
+            print(f"  batch={r['batch']:7d}: step {r['step_us_per_batch']:8.1f} "
+                  f"us  driver +{r['driver_us_per_batch']:8.1f} us "
+                  f"({r['driver_overhead_pct']:.0f}%)", file=sys.stderr)
         print(f"ingest decomposition: framing {dec['framing_ns_per_tuple']:.1f} "
               f"ns/tuple ({dec['framing_gbps']:.2f} GB/s), hash "
               f"{dec['hash_ns_per_tuple']:.1f} ns/tuple, transfer "

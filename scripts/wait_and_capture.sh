@@ -1,6 +1,6 @@
 #!/bin/bash
 # Probe the tunneled TPU every 120s; on first success run the full bench capture.
-# Writes probe log to scripts/tunnel_watch.log and capture output to scripts/capture_r04_*.log
+# Writes probe log to scripts/tunnel_watch.log and capture output to scripts/capture_r05_*.log
 # Standalone YSB result is persisted through bench.record()/record_headline() so a
 # transient tunnel window still updates bench_captures/last_good.json even if the
 # full capture never completes.
@@ -24,15 +24,16 @@ done
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
 timeout 3000 python -c "
 import bench
-tps, step = bench.bench_ysb()
-bench.record('ysb', {'tps': tps, 'step_s': step, 'batch': bench.BATCH},
+tps, step, roof = bench.bench_ysb()
+bench.record('ysb', {'tps': tps, 'step_s': step, 'batch': bench.BATCH,
+                     'roofline': roof},
              methodology='watcher-standalone')
 bench.record_headline({'metric': 'YSB tuples/sec/chip', 'value': round(tps),
                        'unit': 'tuples/s',
                        'vs_baseline': round(tps / bench.BASELINE_TPS, 3)},
                       methodology='watcher-standalone')
 print('YSB:', tps / 1e6, 'M t/s,', step * 1e3, 'ms/step')
-" > "scripts/capture_r04_ysb_$STAMP.log" 2>&1
+" > "scripts/capture_r05_ysb_$STAMP.log" 2>&1
 echo "$(date -u +%FT%TZ) ysb done rc=$?" >> "$LOG"
-WF_BENCH_ALL=1 timeout 7200 python bench.py > "scripts/capture_r04_full_$STAMP.log" 2>&1
+WF_BENCH_ALL=1 timeout 7200 python bench.py > "scripts/capture_r05_full_$STAMP.log" 2>&1
 echo "$(date -u +%FT%TZ) full capture done rc=$?" >> "$LOG"
