@@ -328,12 +328,13 @@ class Ordering_Node:
         self._pending_chan = jnp.pad(chan, (0, pad))
 
     def _trim_pow2(self, n: int):
-        """Compact the retained batch (live lanes first, stable — preserves the
-        sorted invariant) and trim its capacity to the power of two covering the
+        """Trim the retained batch's capacity to the power of two covering the
         live count ``n`` (already fetched with the release counts — no sync
         here) — without this the padded kept capacity compounds with every merge
         (exponential growth); with it, capacities stay pow2 and bounded by ~2x
-        the held-back backlog."""
+        the held-back backlog. The kept pool arrives COMPACTED (live lanes at
+        the front — the roll in ``_split_release`` guarantees it), so the trim
+        is a plain O(cap) head slice, not a sort."""
         b, chan = self._pending, self._pending_chan
         cap = 1
         while cap < max(n, 1):
@@ -341,15 +342,13 @@ class Ordering_Node:
         cap = max(cap, 64)
         if b.capacity <= cap:
             return
-        order = jnp.argsort(~b.valid, stable=True)    # live lanes to the front
-        sel = order[:cap]
 
         def take(a):
-            return jnp.take(a, sel, axis=0)
+            return a[:cap]
         self._pending = Batch(key=take(b.key), id=take(b.id), ts=take(b.ts),
                               payload=jax.tree.map(take, b.payload),
                               valid=take(b.valid))
-        self._pending_chan = jnp.take(chan, sel)
+        self._pending_chan = take(chan)
 
     def try_release(self) -> Optional[Batch]:
         """Release the prefix at or below the current low-watermark (the gating
