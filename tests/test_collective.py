@@ -177,6 +177,17 @@ def test_keyed_all_to_all_residue_identifies_left_rows():
     assert sorted(delivered + left) == [float(i) for i in range(C)]
 
 
+def test_keyed_all_to_all_rejects_zero_capacity():
+    import pytest
+    mesh = make_mesh(MESH, axis="key")
+    C = MESH * (MESH // 2)          # local rows < device count -> default cap 0
+    keys = jnp.zeros(C, jnp.int32)
+    valid = jnp.ones(C, bool)
+    with pytest.raises(ValueError, match="capacity"):
+        jax.jit(keyed_all_to_all(mesh, axis="key"))(
+            keys, valid, {"v": jnp.zeros(C, jnp.float32)})
+
+
 def test_keyed_all_to_all_lossless_delivers_everything():
     from windflow_tpu.parallel.collective import keyed_all_to_all_lossless
     mesh = make_mesh(MESH, axis="key")

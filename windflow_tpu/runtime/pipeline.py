@@ -45,10 +45,21 @@ def record_source_launch(source, batch: Batch) -> None:
     """Per-batch source-side stats: one launch + the H2D bytes the framed batch
     cost (a DeviceSource generates inside the compiled program — zero
     transfer). The SINGLE place H2D bytes are counted (wf/stats_record.hpp:
-    76-80); every driver loop calls this as it pulls a batch from a source."""
+    76-80); every driver loop calls this as it pulls a batch from a source.
+    Byte size is static per capacity — cached on the source after the first
+    batch of each shape (the tree walk is driver-loop overhead otherwise)."""
     from ..operators.source import DeviceSource
-    source.get_StatsRecords()[0].record_launch(
-        hd_bytes=0 if isinstance(source, DeviceSource) else _batch_nbytes(batch))
+    if isinstance(source, DeviceSource):
+        hd = 0
+    else:
+        cache = getattr(source, "_nbytes_by_cap", None)
+        if cache is None:
+            cache = source._nbytes_by_cap = {}
+        cap = batch.capacity
+        hd = cache.get(cap)
+        if hd is None:
+            hd = cache[cap] = _batch_nbytes(batch)
+    source.get_StatsRecords()[0].record_launch(hd_bytes=hd)
 
 
 def _batch_nbytes(batch: Batch) -> int:
@@ -104,6 +115,7 @@ class CompiledChain:
             self.states = [jax.device_put(s, self.device) for s in self.states]
         self._steps = {}
         self._push_count = 0
+        self._nbytes_cache = {}     # (from_op, in capacity) -> (in, out bytes)
 
     def reset_states(self) -> None:
         """Re-initialize every operator's state (supervised replay of a chain
@@ -148,9 +160,14 @@ class CompiledChain:
         # program, so num_kernels counts ONE launch, attributed to the entry op
         # (reference GPU Stats_Record fields, wf/stats_record.hpp:76-80).
         # Byte counts come from static shapes (capacity x itemsize — the
-        # reference counts sizeof(tuple_t) per tuple), no device sync.
-        in_bytes = _batch_nbytes(batch)
-        out_bytes = _batch_nbytes(out)
+        # reference counts sizeof(tuple_t) per tuple), no device sync; static
+        # per capacity, so cached after the first push of each shape.
+        ck = (from_op, batch.capacity)
+        if ck in self._nbytes_cache:
+            in_bytes, out_bytes = self._nbytes_cache[ck]
+        else:
+            in_bytes, out_bytes = _batch_nbytes(batch), _batch_nbytes(out)
+            self._nbytes_cache[ck] = (in_bytes, out_bytes)
         for j in range(from_op, len(self.ops)):
             rec = self.ops[j].get_StatsRecords()[0]
             rec.batches_received += 1
