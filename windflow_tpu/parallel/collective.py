@@ -249,7 +249,13 @@ def keyed_all_to_all_lossless(mesh: Mesh, *, axis: str = "key",
     thread blocking on a full ``FF_BOUNDED_BUFFER`` until the consumer drains it.
     The round count is identical on every process (it is driven by the summed
     left-behind counts, which all processes compute), so the loop is safe under
-    multi-controller execution. Returns ``(keys, valid, payload, n_rounds)``."""
+    multi-controller execution. Returns ``(keys, valid, payload, n_rounds)``.
+
+    Memory note: receiver rounds are concatenated along the batch axis, so the
+    output capacity is ``n_rounds * p * cap`` and the concatenate may leave the
+    result partially replicated depending on XLA's layout choice — size
+    ``capacity`` so the common case is one round, and treat multi-round as the
+    backpressure slow path (exactly like a blocking queue under overload)."""
     ex = jax.jit(keyed_all_to_all(mesh, axis=axis, capacity=capacity,
                                   return_residue=True))
 
