@@ -586,16 +586,20 @@ class PipeGraph:
             merged._ordering = Ordering_Node(len(merged.merge_inputs), mode)
         return merged._ordering
 
-    def _chunks(self, batch: Optional[Batch], n: Optional[int] = None):
-        """Compact a released (variable-capacity) batch and re-slice it into
-        batch_size-capacity pieces so downstream chains keep ONE compiled shape.
-        ``n`` (the valid-lane count) can be passed by callers that already
-        fetched it — Ordering_Node releases carry ``last_release_count`` — to
-        avoid a second device sync."""
+    def _chunks(self, batch: Optional[Batch], n: Optional[int] = None,
+                compact: bool = False):
+        """Re-slice a released (variable-capacity) batch into batch_size-capacity
+        pieces so downstream chains keep ONE compiled shape. ``n`` (the
+        valid-lane count) can be passed by callers that already fetched it —
+        Ordering_Node releases carry ``last_release_count`` — to avoid a second
+        device sync. Ordering_Node releases are prefix-compacted by
+        construction (the sorted-pool release is a physical prefix), so the
+        default skips the compaction sort; pass ``compact=True`` for batches
+        whose live lanes may be scattered."""
         import numpy as np
         if batch is None:
             return
-        b = batch.compact()
+        b = batch.compact() if compact else batch
         if n is None:
             n = int(np.asarray(jnp.sum(b.valid)))
         cap = self.batch_size
