@@ -11,7 +11,9 @@ Variants:
   standalone_<v>  join variant <v> on precomputed device inputs
 where <v> in: factored (current), factored_bf16, take, barrier (factored with
 optimization_barrier-pinned inputs), div (integer ad//ADS_PER_CAMPAIGN — the
-fixture table is contiguous, bound of any real lookup).
+fixture table is contiguous, bound of any real lookup), pallas_gather (per-lane
+VMEM gather in a Pallas kernel, if Mosaic supports it), pallas_onehot (factored
+lookup as ONE Pallas kernel, rows intermediate VMEM-resident).
 Prints one line: PROBE <name> <ms_per_step>. Set WF_DUMP_HLO=1 to also write the
 optimized HLO to scripts/hlo_<name>.txt.
 """
@@ -61,12 +63,72 @@ def _barrier_factored(table, idx):
     return jax.lax.optimization_barrier(_factored_lookup(table, idx))
 
 
+def _pallas_gather(table, idx):
+    """Per-lane VMEM gather inside a Pallas kernel — works iff Mosaic supports
+    vector dynamic gather on this TPU generation; the probe harness exists to
+    find out."""
+    import jax.experimental.pallas as pl
+    C, K = idx.shape[0], table.shape[0]
+    BLK = 8192
+    assert C % BLK == 0, f"pallas probe needs batch % {BLK} == 0, got {C}"
+
+    def kern(t_ref, i_ref, o_ref):
+        o_ref[...] = t_ref[...][i_ref[...]]
+
+    return pl.pallas_call(
+        kern,
+        grid=(C // BLK,),
+        in_specs=[pl.BlockSpec((K,), lambda i: (0,)),
+                  pl.BlockSpec((BLK,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((BLK,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((C,), table.dtype),
+    )(table, idx)
+
+
+def _pallas_onehot(table, idx):
+    """Factored lookup fused into ONE kernel: the [BLK, K2] rows intermediate
+    lives in VMEM (never HBM), killing the 2x round trip the XLA factored form
+    pays on the [C, K2] rows tensor. Row-select via one-hot matmul over K1,
+    column-select via compare+where reduce over K2=128 (lane-aligned)."""
+    import jax.experimental.pallas as pl
+    C, K = idx.shape[0], table.shape[0]
+    K2 = 128
+    K1 = (K + K2 - 1) // K2
+    t2 = jnp.pad(table, (0, K1 * K2 - K)).astype(jnp.float32).reshape(K1, K2)
+    BLK = 8192
+    assert C % BLK == 0, f"pallas probe needs batch % {BLK} == 0, got {C}"
+
+    def kern(t_ref, i_ref, o_ref):
+        idxb = i_ref[...]
+        hi = idxb // K2
+        lo = idxb - hi * K2
+        ohhi = (hi[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (BLK, K1), 1)).astype(jnp.float32)
+        rows = jax.lax.dot_general(ohhi, t_ref[...],
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        ohlo = lo[:, None] == jax.lax.broadcasted_iota(jnp.int32, (BLK, K2), 1)
+        o_ref[...] = jnp.sum(jnp.where(ohlo, rows, 0.0), axis=1)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(C // BLK,),
+        in_specs=[pl.BlockSpec((K1, K2), lambda i: (0, 0)),
+                  pl.BlockSpec((BLK,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((BLK,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((C,), jnp.float32),
+    )(t2, idx)
+    return out.astype(table.dtype)
+
+
 VARIANTS = {
     "factored": lambda ad: _factored_lookup(CAMP_OF, ad),
     "factored_bf16": lambda ad: _factored_bf16(CAMP_OF, ad),
     "take": lambda ad: jnp.take(CAMP_OF, ad),
     "barrier": lambda ad: _barrier_factored(CAMP_OF, ad),
     "div": lambda ad: ad // ysb.ADS_PER_CAMPAIGN,
+    "pallas_gather": lambda ad: _pallas_gather(CAMP_OF, ad),
+    "pallas_onehot": lambda ad: _pallas_onehot(CAMP_OF, ad),
 }
 
 
@@ -96,7 +158,9 @@ def _maybe_dump(name, fn, *args):
 
 def prefix(variant):
     src = ysb.make_source(total=(3 * STEPS + 2) * BATCH)
-    look = VARIANTS.get(variant)
+    # None = prefix2_base (source+filter only); anything else must be a known
+    # variant — .get would silently measure the baseline under a typo'd name
+    look = None if variant is None else VARIANTS[variant]
 
     @jax.jit
     def step(carry, start):

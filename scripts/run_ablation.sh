@@ -1,0 +1,10 @@
+#!/bin/bash
+# Per-prefix YSB ablation, one fresh process per prefix (r03 integrity rule).
+# Results append to scripts/ablation.log. Usage: run_ablation.sh [batch]
+cd /root/repo
+LOG=scripts/ablation.log
+echo "=== $(date -u +%FT%TZ) batch=${1:-1048576}" >> "$LOG"
+for n in 0 1 2 3 4; do
+  timeout 900 python scripts/probe_ysb_ablation.py "$n" "${1:-1048576}" >> "$LOG" 2>&1
+done
+tail -6 "$LOG"

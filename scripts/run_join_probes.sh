@@ -5,8 +5,10 @@ cd /root/repo
 LOG=scripts/join_probes.log
 echo "=== $(date -u +%FT%TZ) batch=${1:-1048576}" >> "$LOG"
 for p in prefix2_base prefix2_factored prefix2_factored_bf16 prefix2_take \
-         prefix2_barrier prefix2_div standalone_factored \
-         standalone_factored_bf16 standalone_take standalone_div; do
+         prefix2_barrier prefix2_div prefix2_pallas_gather \
+         prefix2_pallas_onehot standalone_factored \
+         standalone_factored_bf16 standalone_take standalone_div \
+         standalone_pallas_gather standalone_pallas_onehot; do
   timeout 900 python scripts/probe_join.py "$p" "${1:-1048576}" >> "$LOG" 2>&1
 done
-tail -12 "$LOG"
+tail -16 "$LOG"

@@ -1,0 +1,37 @@
+#!/bin/bash
+# r05 probe watcher: the YSB headline is already captured fresh this round
+# (bench_captures/last_good.json, 2026-07-31T03:48Z). What the next tunnel
+# window is FOR is diagnosis: the per-prefix ablation and the join-variant
+# probes that decide the next perf fix. Probe every 120s; on first success run
+# ablation -> join probes -> keyed_cb refresh (for the roofline overcount
+# annotation). Logs: scripts/tunnel_watch.log, scripts/ablation.log,
+# scripts/join_probes.log.
+cd /root/repo
+LOG=scripts/tunnel_watch.log
+echo "$(date -u +%FT%TZ) probe-watcher start" >> "$LOG"
+while true; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jax.device_put(jnp.ones((1024,), jnp.float32))
+assert float((x*2).sum()) == 2048.0
+print('probe ok:', d)
+" >> "$LOG" 2>&1; then
+    echo "$(date -u +%FT%TZ) TUNNEL UP — running r05 probes" >> "$LOG"
+    break
+  fi
+  echo "$(date -u +%FT%TZ) probe failed/hung" >> "$LOG"
+  sleep 120
+done
+bash scripts/run_ablation.sh
+echo "$(date -u +%FT%TZ) ablation done" >> "$LOG"
+bash scripts/run_join_probes.sh
+echo "$(date -u +%FT%TZ) join probes done" >> "$LOG"
+timeout 900 python -c "
+import bench
+r = bench._run_isolated('bench_keyed_cb()')
+bench.record('keyed_cb', {'tps': r[0], 'step_s': r[1], 'roofline': r[2]},
+             methodology='isolated-subprocess')
+print('keyed_cb refreshed', r[0]/1e6)
+" >> "$LOG" 2>&1
+echo "$(date -u +%FT%TZ) probe-watcher done" >> "$LOG"
