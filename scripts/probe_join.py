@@ -86,39 +86,14 @@ def _pallas_gather(table, idx):
 
 
 def _pallas_onehot(table, idx):
-    """Factored lookup fused into ONE kernel: the [BLK, K2] rows intermediate
-    lives in VMEM (never HBM), killing the 2x round trip the XLA factored form
-    pays on the [C, K2] rows tensor. Row-select via one-hot matmul over K1,
-    column-select via compare+where reduce over K2=128 (lane-aligned)."""
-    import jax.experimental.pallas as pl
-    C, K = idx.shape[0], table.shape[0]
-    K2 = 128
-    K1 = (K + K2 - 1) // K2
-    t2 = jnp.pad(table, (0, K1 * K2 - K)).astype(jnp.float32).reshape(K1, K2)
-    BLK = 8192
-    assert C % BLK == 0, f"pallas probe needs batch % {BLK} == 0, got {C}"
-
-    def kern(t_ref, i_ref, o_ref):
-        idxb = i_ref[...]
-        hi = idxb // K2
-        lo = idxb - hi * K2
-        ohhi = (hi[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (BLK, K1), 1)).astype(jnp.float32)
-        rows = jax.lax.dot_general(ohhi, t_ref[...],
-                                   (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-        ohlo = lo[:, None] == jax.lax.broadcasted_iota(jnp.int32, (BLK, K2), 1)
-        o_ref[...] = jnp.sum(jnp.where(ohlo, rows, 0.0), axis=1)
-
-    out = pl.pallas_call(
-        kern,
-        grid=(C // BLK,),
-        in_specs=[pl.BlockSpec((K1, K2), lambda i: (0, 0)),
-                  pl.BlockSpec((BLK,), lambda i: (i,))],
-        out_specs=pl.BlockSpec((BLK,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((C,), jnp.float32),
-    )(t2, idx)
-    return out.astype(table.dtype)
+    """The PRODUCTION one-kernel factored lookup
+    (windflow_tpu.ops.lookup._pallas_factored_lookup): rows intermediate
+    VMEM-resident. Imported, not duplicated — the probe decides whether to
+    adopt that exact function in the chain, so it must measure it."""
+    from windflow_tpu.ops.lookup import _pallas_block, _pallas_factored_lookup
+    assert _pallas_block(idx.shape[0]), \
+        f"batch {idx.shape[0]} not blockable by the production kernel"
+    return _pallas_factored_lookup(table, idx)
 
 
 VARIANTS = {

@@ -7,4 +7,51 @@ echo "=== $(date -u +%FT%TZ) batch=${1:-1048576}" >> "$LOG"
 for n in 0 1 2 3 4; do
   timeout 900 python scripts/probe_ysb_ablation.py "$n" "${1:-1048576}" >> "$LOG" 2>&1
 done
-tail -6 "$LOG"
+# Mosaic lowering precheck on tiny shapes, one fresh short-timeout process per
+# kernel: a variant whose store pattern Mosaic refuses (the "ds" dynamic
+# minor-dim slice is the suspect) must fail HERE in seconds, not burn a
+# 900 s probe slot mid-window. Probes below only run for variants that pass.
+hist_ok=""
+for pv in ds mm; do
+  if timeout 300 python -c "
+import numpy as np, jax.numpy as jnp
+from windflow_tpu.ops.histogram import keyed_pane_histogram_pallas, _scatter_hist
+key = jnp.asarray(np.arange(2048) % 8, jnp.int32)
+pane = jnp.asarray(np.arange(2048) // 600 + 30, jnp.int32)
+valid = jnp.ones((2048,), bool)
+got = keyed_pane_histogram_pallas(key, pane, valid, 8, 32, placement='$pv')
+assert (np.asarray(got) == np.asarray(_scatter_hist(key, pane, valid, 8, 32))).all()
+print('hist $pv lowers + matches')
+" >> "$LOG" 2>&1; then hist_ok="$hist_ok $pv"; else
+    echo "PRECHECK hist $pv FAILED (skipping its probes)" >> "$LOG"; fi
+done
+lookup_ok=0
+if timeout 300 python -c "
+import numpy as np, jax.numpy as jnp
+from windflow_tpu.ops.lookup import _pallas_factored_lookup
+t = jnp.asarray(np.arange(1000, dtype=np.int32) // 10)
+i = jnp.asarray((np.arange(8192) * 7919 % 1000).astype(np.int32))
+got = _pallas_factored_lookup(t, i)
+assert (np.asarray(got) == np.asarray(t)[np.asarray(i)]).all()
+print('lookup pallas lowers + matches')
+" >> "$LOG" 2>&1; then lookup_ok=1; else
+  echo "PRECHECK lookup pallas FAILED (skipping its probes)" >> "$LOG"; fi
+
+# Pallas-impl A/Bs against the XLA ABLATE rows above, one fresh process each:
+# window-insert kernel alone, join kernel alone, and the all-Pallas chain.
+best_hist=""
+for pv in $hist_ok; do
+  impl=pallas; [ "$pv" = mm ] && impl=pallas_mm
+  echo "--- WF_HISTOGRAM_IMPL=$impl prefix 4" >> "$LOG"
+  WF_HISTOGRAM_IMPL=$impl timeout 900 python scripts/probe_ysb_ablation.py 4 "${1:-1048576}" >> "$LOG" 2>&1
+  best_hist=$impl
+done
+if [ "$lookup_ok" = 1 ]; then
+  echo "--- WF_LOOKUP_IMPL=pallas prefix 2" >> "$LOG"
+  WF_LOOKUP_IMPL=pallas timeout 900 python scripts/probe_ysb_ablation.py 2 "${1:-1048576}" >> "$LOG" 2>&1
+  if [ -n "$best_hist" ]; then
+    echo "--- both pallas prefix 4 (hist=$best_hist)" >> "$LOG"
+    WF_LOOKUP_IMPL=pallas WF_HISTOGRAM_IMPL=$best_hist timeout 900 python scripts/probe_ysb_ablation.py 4 "${1:-1048576}" >> "$LOG" 2>&1
+  fi
+fi
+tail -20 "$LOG"

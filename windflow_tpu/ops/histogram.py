@@ -28,6 +28,8 @@ semantics change.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -42,12 +44,18 @@ K_TILE = 512
 def keyed_pane_histogram(key: jax.Array, pane: jax.Array, valid: jax.Array,
                          num_keys: int, ring: int, *,
                          chunk: int = DEFAULT_CHUNK, locality: int = DEFAULT_L,
+                         impl: str = None,
                          ) -> jax.Array:
     """Count histogram ``out[k, pane % ring] = #{lanes: key==k, pane==p}``.
 
     ``key``: i32[C] in [0, num_keys); ``pane``: i32[C] (arbitrary, ring-mapped);
     ``valid``: bool[C]. Returns i32[num_keys, ring]. Exact for any input (locality
     violations fall back to scatter-add inside the same compiled program).
+
+    ``impl``: "xla" (default; the inline einsum formulation below) or "pallas"
+    (:func:`keyed_pane_histogram_pallas`'s kernel as the fast branch — same
+    locality cond, same scatter fallback). Defaults from ``WF_HISTOGRAM_IMPL``
+    so a whole chain can be A/B'd without code changes.
     """
     C = key.shape[0]
     K, P = int(num_keys), int(ring)
@@ -99,6 +107,16 @@ def keyed_pane_histogram(key: jax.Array, pane: jax.Array, valid: jax.Array,
                                   preferred_element_type=jnp.float32)
         return out.astype(jnp.int32)
 
+    impl = impl or os.environ.get("WF_HISTOGRAM_IMPL", "xla")
+    if impl.startswith("pallas"):
+        # "pallas": dynamic-slice store of the [K, L] chunk histogram into the
+        # ring (8-wide store at a traced lane offset — Mosaic may refuse the
+        # minor-dim dynamic slice on some generations). "pallas_mm": placement
+        # by one-hot matmul into the full [K, P+L] block (static stores only —
+        # guaranteed to lower, more VPU adds per chunk).
+        placement = "mm" if impl == "pallas_mm" else "ds"
+        fast = lambda _: _pallas_fast(key, pane, valid, K, P,  # noqa: E731
+                                      chunk, locality, placement=placement)
     return jax.lax.cond(in_bounds, fast,
                         lambda _: _scatter_hist(key, pane, valid, K, P), None)
 
@@ -107,3 +125,97 @@ def _scatter_hist(key, pane, valid, K, P):
     seg = jnp.where(valid, key * P + pane % P, K * P)
     return jax.ops.segment_sum(valid.astype(jnp.int32), seg,
                                num_segments=K * P).reshape(K, P)
+
+
+def keyed_pane_histogram_pallas(key: jax.Array, pane: jax.Array,
+                                valid: jax.Array, num_keys: int, ring: int, *,
+                                chunk: int = DEFAULT_CHUNK,
+                                locality: int = DEFAULT_L,
+                                placement: str = "ds",
+                                interpret: bool = False) -> jax.Array:
+    """Pallas formulation of :func:`keyed_pane_histogram`'s fast path: one
+    kernel owns the whole ``[C] -> [K, P]`` accumulation, so the chunk one-hots
+    and per-chunk ``[K, L]`` partials live in VMEM for their entire life — no
+    fusion decision XLA can get wrong in a larger program (the YSB chain
+    measures the XLA form at ~5 ms in-chain vs 15 us standalone; this kernel
+    exists to make the standalone cost the only cost).
+
+    Grid = one step per chunk (TPU grids run sequentially, so read-modify-write
+    accumulation into the output ref across steps is sound). Ring wrap-around
+    is handled by padding the ring with ``locality`` spill columns the kernel
+    stores into contiguously (``base % P`` never wraps past ``P + L``) and
+    folding them back afterwards — no in-kernel modular scatter.
+
+    PRECONDITION (caller-enforced, same as the XLA fast path): every chunk
+    spans < ``locality`` panes among its valid lanes. The framework wraps both
+    implementations in the same ``lax.cond`` locality check with the exact
+    scatter path as fallback (``keyed_pane_histogram(..., impl="pallas")``).
+    ``interpret=True`` runs the kernel in Pallas interpret mode (CPU-testable;
+    auto-enabled on the CPU backend)."""
+    C = key.shape[0]
+    K, P = int(num_keys), int(ring)
+    if C % chunk != 0 or C < chunk:
+        return _scatter_hist(key, pane, valid, K, P)
+    return _pallas_fast(key, pane, valid, K, P, chunk, locality,
+                        placement=placement, interpret=interpret)
+
+
+def _pallas_fast(key, pane, valid, K, P, chunk, locality, *,
+                 placement: str = "ds", interpret: bool = False):
+    import jax.experimental.pallas as pl
+
+    C = key.shape[0]
+    L = int(locality)
+    R = C // chunk
+    big = jnp.iinfo(pane.dtype).max
+    interpret = interpret or jax.default_backend() == "cpu"
+
+    def kern(key_ref, pane_ref, valid_ref, out_ref):
+        r = pl.program_id(0)
+
+        @pl.when(r == 0)
+        def _zero():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        kc = key_ref[...]
+        pc = pane_ref[...]
+        vc = valid_ref[...] != 0
+        base = jnp.min(jnp.where(vc, pc, big))
+        base = jnp.where(base == big, 0, base)
+        local = pc - base
+        ok = vc & (local < L)
+        lr = jnp.where(ok, local, 0)
+        ohk = ((kc[:, None] == jax.lax.broadcasted_iota(
+            kc.dtype, (chunk, K), 1)) & ok[:, None]).astype(jnp.bfloat16)
+        ohl = ((lr[:, None] == jax.lax.broadcasted_iota(
+            lr.dtype, (chunk, L), 1)) & ok[:, None]).astype(jnp.bfloat16)
+        h = jax.lax.dot_general(ohk, ohl, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [K, L]
+        start = base % P                      # [0, P): contiguous in P + L cols
+        if placement == "ds":
+            cur = out_ref[:, pl.ds(start, L)]
+            out_ref[:, pl.ds(start, L)] = cur + h.astype(jnp.float32)
+        else:
+            # static-store placement: one-hot [L, P+L] matmul scatters the L
+            # columns; the accumulate touches the whole block but every memory
+            # op has a static shape and offset (always lowers)
+            ohp = (jax.lax.broadcasted_iota(jnp.int32, (L, P + L), 1)
+                   == start + jax.lax.broadcasted_iota(
+                       jnp.int32, (L, P + L), 0)).astype(jnp.float32)
+            out_ref[...] += jax.lax.dot_general(
+                h, ohp, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    padded = pl.pallas_call(
+        kern,
+        grid=(R,),
+        in_specs=[pl.BlockSpec((chunk,), lambda r: (r,)),
+                  pl.BlockSpec((chunk,), lambda r: (r,)),
+                  pl.BlockSpec((chunk,), lambda r: (r,))],
+        out_specs=pl.BlockSpec((K, P + L), lambda r: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((K, P + L), jnp.float32),
+        interpret=interpret,
+    )(key, pane, valid.astype(jnp.int32))
+    # fold the spill columns back onto the ring head (wrap-around completion)
+    out = padded[:, :P].at[:, :L].add(padded[:, P:])
+    return out.astype(jnp.int32)

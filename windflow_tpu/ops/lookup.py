@@ -10,6 +10,8 @@ state-table reads.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -32,7 +34,8 @@ def _exact_in_f32(table: jax.Array) -> bool:
     return table.dtype == jnp.bool_
 
 
-def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
+def table_lookup(table: jax.Array, idx: jax.Array, *,
+                 impl: str = None) -> jax.Array:
     """``table[idx]`` with the fastest strategy for the table size.
 
     Strategies (1-D tables): tiny tables use a select-reduce on the VPU; larger ones
@@ -42,9 +45,21 @@ def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     int32 tables with values that may exceed 2^24 fall back to ``take`` (f32 selection
     would round them).
 
+    ``impl``: "xla" (default) or "pallas" — routes the factored path through
+    :func:`_pallas_factored_lookup` (rows intermediate VMEM-resident) when the
+    capacity geometry allows. Defaults from ``WF_LOOKUP_IMPL`` so whole chains
+    can be A/B'd without code changes.
+
     ``table``: ``[K, ...]``; ``idx``: ``[C]`` int32 in [0, K). Out-of-range indices
     return 0 in the select/factored paths; clamp beforehand if needed."""
     K = table.shape[0]
+    impl = impl or os.environ.get("WF_LOOKUP_IMPL", "xla")
+
+    def factored(t, i):
+        if impl == "pallas" and i.ndim == 1 and _pallas_block(i.shape[0]):
+            return _pallas_factored_lookup(t, i)
+        return _factored_lookup(t, i)
+
     if table.ndim == 1 and SELECT_MAX_ROWS < K <= FACTORED_MAX_ROWS:
         import numpy as np
         concrete = table.size and not isinstance(table, jax.core.Tracer)
@@ -52,12 +67,12 @@ def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
             # 0 * inf = NaN in the one-hot matmul would poison other rows:
             # only concretely all-finite float tables take the factored path
             if concrete and bool(np.isfinite(np.asarray(table)).all()):
-                return _factored_lookup(table, idx)
+                return factored(table, idx)
         elif _exact_in_f32(table):
-            return _factored_lookup(table, idx)
+            return factored(table, idx)
         elif (jnp.issubdtype(table.dtype, jnp.integer) and concrete
                 and np.abs(np.asarray(table)).max() < (1 << 24)):
-            return _factored_lookup(table, idx)
+            return factored(table, idx)
         # factored path unavailable (traced table / values beyond f32-exact range):
         # the select-reduce below is exact in the table's own dtype and still beats
         # the serialized gather up to the 2-D break-even
@@ -74,6 +89,64 @@ def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     # [C, K, V] select-reduce for small trailing dims
     return jnp.sum(jnp.where(oh[:, :, None], table[None, :, :],
                              jnp.zeros((), table.dtype)), axis=1)
+
+
+def _pallas_block(C: int) -> int:
+    """Lane count per Pallas lookup kernel invocation; 0 if the capacity can't
+    be blocked (fall back to the XLA factored form)."""
+    if C >= 8192 and C % 8192 == 0:
+        return 8192
+    if 128 <= C < 8192 and C % 128 == 0:
+        return C
+    return 0
+
+
+def _pallas_factored_lookup(table: jax.Array, idx: jax.Array, *,
+                            interpret: bool = False) -> jax.Array:
+    """Factored lookup as ONE Pallas kernel: row-select by one-hot matmul over
+    ``K1 = ceil(K/128)`` coarse rows, column-select by compare+where reduce
+    over ``K2 = 128`` lanes — with the ``[BLK, K2]`` rows intermediate living
+    its whole life in VMEM. The XLA factored form (:func:`_factored_lookup`)
+    materializes rows as a ``[C, K2]`` HBM tensor (one write + one read ≈
+    2 × C × 512 B), which bounds it at ~0.3 ms for C = 1M; in-kernel the HBM
+    traffic is just idx in + out out (8 B/lane). Same exactness envelope as
+    the XLA form: callers must have checked the table is f32-exact.
+
+    Selected by ``table_lookup`` when ``WF_LOOKUP_IMPL=pallas`` (or
+    ``impl="pallas"``) and the geometry allows (C a multiple of 128)."""
+    import jax.experimental.pallas as pl
+
+    C, K = idx.shape[0], table.shape[0]
+    BLK = _pallas_block(C)
+    assert BLK, f"capacity {C} not blockable; caller must gate on _pallas_block"
+    K2 = 128
+    K1 = (K + K2 - 1) // K2
+    t2 = jnp.pad(table, (0, K1 * K2 - K)).astype(jnp.float32).reshape(K1, K2)
+    interpret = interpret or jax.default_backend() == "cpu"
+
+    def kern(t_ref, i_ref, o_ref):
+        idxb = i_ref[...]
+        hi = idxb // K2
+        lo = idxb - hi * K2
+        ohhi = (hi[:, None] == jax.lax.broadcasted_iota(
+            idxb.dtype, (BLK, K1), 1)).astype(jnp.float32)
+        rows = jax.lax.dot_general(ohhi, t_ref[...],
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        ohlo = lo[:, None] == jax.lax.broadcasted_iota(
+            idxb.dtype, (BLK, K2), 1)
+        o_ref[...] = jnp.sum(jnp.where(ohlo, rows, 0.0), axis=1)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(C // BLK,),
+        in_specs=[pl.BlockSpec((K1, K2), lambda i: (0, 0)),
+                  pl.BlockSpec((BLK,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((BLK,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((C,), jnp.float32),
+        interpret=interpret,
+    )(t2, idx)
+    return out.astype(table.dtype)
 
 
 def _factored_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
