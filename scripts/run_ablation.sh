@@ -5,7 +5,10 @@ cd /root/repo
 LOG=scripts/ablation.log
 echo "=== $(date -u +%FT%TZ) batch=${1:-1048576}" >> "$LOG"
 for n in 0 1 2 3 4; do
-  timeout 900 python scripts/probe_ysb_ablation.py "$n" "${1:-1048576}" >> "$LOG" 2>&1
+  # HLO dumps for the join/rekey/window prefixes: the fusion diff between
+  # hlo_ablate_3 and hlo_ablate_4 is the in-chain-slowdown evidence
+  dump=""; [ "$n" -ge 2 ] && dump="WF_DUMP_HLO=1"
+  env $dump timeout 900 python scripts/probe_ysb_ablation.py "$n" "${1:-1048576}" >> "$LOG" 2>&1
 done
 # Mosaic lowering precheck on tiny shapes, one fresh short-timeout process per
 # kernel: a variant whose store pattern Mosaic refuses (the "ds" dynamic

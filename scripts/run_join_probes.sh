@@ -9,6 +9,8 @@ for p in prefix2_base prefix2_factored prefix2_factored_bf16 prefix2_take \
          prefix2_pallas_onehot standalone_factored \
          standalone_factored_bf16 standalone_take standalone_div \
          standalone_pallas_gather standalone_pallas_onehot; do
-  timeout 900 python scripts/probe_join.py "$p" "${1:-1048576}" >> "$LOG" 2>&1
+  dump=""
+  case "$p" in prefix2_base|prefix2_factored|standalone_factored) dump="WF_DUMP_HLO=1";; esac
+  env $dump timeout 900 python scripts/probe_join.py "$p" "${1:-1048576}" >> "$LOG" 2>&1
 done
 tail -16 "$LOG"

