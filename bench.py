@@ -935,10 +935,21 @@ def main():
     print(json.dumps(headline))
 
 
-def _secondary_benches(ysb_tps, ysb_step_s):
-    sl_tps, sl_step_s, sl_roof = bench_stateless()
+def capture_stateless_isolated():
+    """Run bench_stateless in its own process and persist the capture — the
+    ONE recipe for this row (bench runs and the probe watcher both call it).
+    In-session it would run right after YSB and measure the same-process
+    dispatch degradation (r03 finding), not the program: the 2026-07-31
+    in-session capture read 1.83 ms/step at 0.07% HBM utilization for a
+    map+filter whose traffic bound is ~50 us."""
+    sl_tps, sl_step_s, sl_roof = _run_isolated("bench_stateless()")
     record("stateless", {"tps": sl_tps, "step_s": sl_step_s, "batch": BATCH,
-                         "roofline": sl_roof})
+                         "roofline": sl_roof}, methodology="isolated-subprocess")
+    return sl_tps, sl_step_s, sl_roof
+
+
+def _secondary_benches(ysb_tps, ysb_step_s):
+    sl_tps, sl_step_s, sl_roof = capture_stateless_isolated()
     print(f"YSB: {ysb_tps/1e6:.2f} M tuples/s ({ysb_step_s*1e3:.2f} ms/step, "
           f"batch={BATCH})", file=sys.stderr)
     print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "

@@ -40,6 +40,13 @@ print('lookup pallas lowers + matches')
 " >> "$LOG" 2>&1; then lookup_ok=1; else
   echo "PRECHECK lookup pallas FAILED (skipping its probes)" >> "$LOG"; fi
 
+# Decisive cond-flattening diagnostic: if prefix 4 collapses with the locality
+# cond bypassed, the serialized scatter FALLBACK branch was executing every
+# step in-chain (select-both-branches flattening), and the fix is the cond
+# structure, not the fast path.
+echo "--- WF_HISTOGRAM_FORCE_FAST=1 prefix 4" >> "$LOG"
+WF_HISTOGRAM_FORCE_FAST=1 timeout 900 python scripts/probe_ysb_ablation.py 4 "${1:-1048576}" >> "$LOG" 2>&1
+
 # Pallas-impl A/Bs against the XLA ABLATE rows above, one fresh process each:
 # window-insert kernel alone, join kernel alone, and the all-Pallas chain.
 best_hist=""
@@ -57,4 +64,12 @@ if [ "$lookup_ok" = 1 ]; then
     WF_LOOKUP_IMPL=pallas WF_HISTOGRAM_IMPL=$best_hist timeout 900 python scripts/probe_ysb_ablation.py 4 "${1:-1048576}" >> "$LOG" 2>&1
   fi
 fi
-tail -20 "$LOG"
+# refresh the stateless capture under process isolation: the in-session row
+# measured post-YSB dispatch degradation (1.83 ms/step at 0.07% HBM), not the
+# program
+timeout 900 python -c "
+import bench
+r = bench.capture_stateless_isolated()
+print('stateless isolated:', r[0] / 1e6, 'M t/s,', r[1] * 1e3, 'ms/step')
+" >> "$LOG" 2>&1
+tail -22 "$LOG"

@@ -108,6 +108,7 @@ def keyed_pane_histogram(key: jax.Array, pane: jax.Array, valid: jax.Array,
         return out.astype(jnp.int32)
 
     impl = impl or os.environ.get("WF_HISTOGRAM_IMPL", "xla")
+    force_fast = bool(os.environ.get("WF_HISTOGRAM_FORCE_FAST"))
     if impl.startswith("pallas"):
         # "pallas": dynamic-slice store of the [K, L] chunk histogram into the
         # ring (8-wide store at a traced lane offset — Mosaic may refuse the
@@ -117,6 +118,14 @@ def keyed_pane_histogram(key: jax.Array, pane: jax.Array, valid: jax.Array,
         placement = "mm" if impl == "pallas_mm" else "ds"
         fast = lambda _: _pallas_fast(key, pane, valid, K, P,  # noqa: E731
                                       chunk, locality, placement=placement)
+    if force_fast:
+        # DIAGNOSTIC ONLY (WF_HISTOGRAM_FORCE_FAST): skip the locality cond and
+        # run the fast path unconditionally. If XLA flattens the cond in a
+        # larger program (select-both-branches), the serialized scatter branch
+        # executes every step even though in_bounds is always true — this
+        # bypass isolates that hypothesis in the per-prefix ablation. WRONG for
+        # inputs that violate chunk locality; never set it in production.
+        return fast(None)
     return jax.lax.cond(in_bounds, fast,
                         lambda _: _scatter_hist(key, pane, valid, K, P), None)
 

@@ -192,17 +192,27 @@ def _sort_batch(mode, batch: Batch, chan):
     because ordered arrivals insert at the heap root, ``wf/ordering_node.hpp:
     79-94``). Both branches are value-identical on sorted input (stable
     lexsort of a sorted sequence is the identity permutation), so the
-    data-dependent cond cannot leak into output order."""
+    data-dependent cond cannot leak into output order.
+
+    The 2x DETERMINISTIC win is measured IN-CHAIN on the CPU backend
+    (bench_ordering_overhead), so XLA:CPU does not flatten this cond into
+    select-both-branches; whether XLA:TPU does is A/B-able without code
+    changes via ``WF_ORDERING_SKIP_SORTED=0`` (re-enables the unconditional
+    lexsort) — the same diagnostic pattern as WF_HISTOGRAM_FORCE_FAST."""
+    import os
     bp, bs, bc = _masked_keys(mode, batch, chan)
+
+    def dosort(_):
+        order = jnp.lexsort((bc, bs, bp)).astype(jnp.int32)
+        return bp[order], bs[order], bc[order], order
+
+    if os.environ.get("WF_ORDERING_SKIP_SORTED", "1") == "0":
+        return dosort(None)
     asc = ~_lex_lt((bp[1:], bs[1:], bc[1:]), (bp[:-1], bs[:-1], bc[:-1]))
     iota = jnp.arange(batch.capacity, dtype=jnp.int32)
 
     def ident(_):
         return bp, bs, bc, iota
-
-    def dosort(_):
-        order = jnp.lexsort((bc, bs, bp)).astype(jnp.int32)
-        return bp[order], bs[order], bc[order], order
 
     return jax.lax.cond(jnp.all(asc), ident, dosort, None)
 
