@@ -700,14 +700,26 @@ def bench_drive_loop(batches=(4096, 262144, 1 << 20),
                wf.ReduceSink(lambda t: t.v, name="out")]
         chain = CompiledChain(ops, src.payload_spec(), batch_capacity=B)
 
-        def step(states, start):
-            b = src.make_batch(jnp.asarray(start, jnp.int32), B)
+        # bare loop carries a DEVICE cursor exactly like the driven path
+        # (operators/source.py::batches) — if it uploaded a host int per step
+        # the ~0.1 ms H2D would no longer cancel in the subtraction and
+        # driver_us_per_batch would read low by that amount
+        def step(states, cur):
+            b = src.make_batch(cur, B)
             states = list(states)
             for j, op in enumerate(chain.ops):
                 states[j], b = op.apply(states[j], b)
-            return tuple(states), b.valid
-        step = jax.jit(step, donate_argnums=0)
-        bare_s, _ = _bench_loop(step, tuple(chain.states), n2 - n1, B)
+            return tuple(states), cur + B, b.valid
+        step = jax.jit(step, donate_argnums=(0, 1))
+        states_b = tuple(chain.states)
+        cur = jnp.asarray(0, jnp.int32)
+        states_b, cur, out = step(states_b, cur)      # warm/compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n2 - n1):
+            states_b, cur, out = step(states_b, cur)
+        jax.block_until_ready(out)
+        bare_s = time.perf_counter() - t0
 
         step_us = bare_s / (n2 - n1) * 1e6
         drv_us = per_batch_s * 1e6 - step_us

@@ -279,13 +279,30 @@ class DeviceSource(SourceBase):
         return out
 
     def batches(self, batch_size: int = DEFAULT_BATCH_SIZE, cursor=None):
-        make = jax.jit(self.make_batch, static_argnums=1)
+        # The stream cursor is DEVICE-RESIDENT and advanced in-program: one
+        # host->device scalar upload at open (or seek), zero per batch. The
+        # naive form — jnp.asarray(start) per batch — costs a 4 B H2D on every
+        # push (~0.1 ms even on the CPU backend, an RTT-class cost through the
+        # tunneled dev chip; profiled as a top per-batch driver term).
+        if self.total > jnp.iinfo(CTRL_DTYPE).max:
+            # the device cursor would silently WRAP past the dtype max inside
+            # the jitted step (the old host-int form raised OverflowError);
+            # fail loudly at open instead of corrupting ids mid-stream
+            raise ValueError(
+                f"DeviceSource total={self.total} exceeds the i32 control "
+                f"dtype ({jnp.iinfo(CTRL_DTYPE).max}); chunk the stream into "
+                f"multiple sources/runs")
+        if not hasattr(self, "_step_jit"):
+            self._step_jit = jax.jit(
+                lambda c, n: (self.make_batch(c, n), c + n), static_argnums=1)
         self._pos = int(cursor or 0)            # O(1) seek: pure index arithmetic
-        for start in range(self._pos * batch_size, self.total, batch_size):
+        cur = jnp.asarray(self._pos * batch_size, CTRL_DTYPE)
+        for _ in range(self._pos * batch_size, self.total, batch_size):
             # bump BEFORE yield: cursor() is read while suspended at the yield,
             # and must count the batch just handed out
             self._pos += 1
-            yield make(jnp.asarray(start, CTRL_DTYPE), batch_size)
+            b, cur = self._step_jit(cur, batch_size)
+            yield b
 
     def cursor(self):
         return getattr(self, "_pos", 0)
