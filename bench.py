@@ -348,19 +348,18 @@ def bench_latency_curve(batches=(4096, 16384, 65536, 262144), steps: int = 80,
                            max_wins=panes_per_batch + 64)
         chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
 
-        def step(states, start):
-            b = src.make_batch(jnp.asarray(start, jnp.int32), batch)
-            states = list(states)
-            for j, op in enumerate(chain.ops):
-                states[j], b = op.apply(states[j], b)
-            packed = jnp.stack([b.key, b.id,
-                                jnp.asarray(b.payload, jnp.int32),
-                                b.valid.astype(jnp.int32)])
-            return tuple(states), packed
-
-        step = jax.jit(step, donate_argnums=0)
+        # device-resident cursor, advanced in-program: a per-step host-scalar
+        # upload would sit INSIDE every latency sample (RTT-class through the
+        # tunnel) and under-pipeline the curve
+        from windflow_tpu.benchmarks import device_cursor_step
+        step = device_cursor_step(
+            chain, src, batch,
+            out_fn=lambda b: jnp.stack([b.key, b.id,
+                                        jnp.asarray(b.payload, jnp.int32),
+                                        b.valid.astype(jnp.int32)]))
         states = tuple(chain.states)
-        states, packed = step(states, 0)
+        cur = jnp.asarray(0, jnp.int32)
+        states, cur, packed = step(states, cur)
         jax.block_until_ready(packed)                     # compile outside timing
 
         shipper = AsyncResultShipper(depth=depth)
@@ -368,7 +367,7 @@ def bench_latency_curve(batches=(4096, 16384, 65536, 262144), steps: int = 80,
         n_results = 0
         t_wall0 = time.perf_counter()
         for i in range(1, steps + 1):
-            states, packed = step(states, i * batch)      # async dispatch
+            states, cur, packed = step(states, cur)       # async dispatch
             shipper.ship(packed, tag=i)
             for rec in shipper.harvest():                 # blocks only past depth
                 lat.append(rec.receipt_time - rec.ship_time)
@@ -704,13 +703,8 @@ def bench_drive_loop(batches=(4096, 262144, 1 << 20),
         # (operators/source.py::batches) — if it uploaded a host int per step
         # the ~0.1 ms H2D would no longer cancel in the subtraction and
         # driver_us_per_batch would read low by that amount
-        def step(states, cur):
-            b = src.make_batch(cur, B)
-            states = list(states)
-            for j, op in enumerate(chain.ops):
-                states[j], b = op.apply(states[j], b)
-            return tuple(states), cur + B, b.valid
-        step = jax.jit(step, donate_argnums=(0, 1))
+        from windflow_tpu.benchmarks import device_cursor_step
+        step = device_cursor_step(chain, src, B)
         states_b = tuple(chain.states)
         cur = jnp.asarray(0, jnp.int32)
         states_b, cur, out = step(states_b, cur)      # warm/compile

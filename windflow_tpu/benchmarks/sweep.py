@@ -23,30 +23,25 @@ from typing import Callable, Dict, List, Tuple
 
 def _throughput(step: Callable, states, n_steps: int, batch: int) -> float:
     import jax
-    states, out = step(states, 0)
+    import jax.numpy as jnp
+    # device-resident cursor, advanced in-program — no per-step host scalar
+    # upload (same discipline as operators/source.py::batches)
+    cur = jnp.asarray(0, jnp.int32)
+    states, cur, out = step(states, cur)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    for i in range(1, n_steps + 1):
-        states, out = step(states, i * batch)
+    for _ in range(n_steps):
+        states, cur, out = step(states, cur)
     jax.block_until_ready(out)
     return n_steps * batch / (time.perf_counter() - t0)
 
 
 def _chain_step(ops, src, batch):
-    import jax
-    import jax.numpy as jnp
+    from . import device_cursor_step
     from ..runtime.pipeline import CompiledChain
 
     chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
-
-    def step(states, start):
-        b = src.make_batch(jnp.asarray(start, jnp.int32), batch)
-        states = list(states)
-        for j, op in enumerate(chain.ops):
-            states[j], b = op.apply(states[j], b)
-        return tuple(states), b.valid
-
-    return jax.jit(step, donate_argnums=0), tuple(chain.states)
+    return device_cursor_step(chain, src, batch), tuple(chain.states)
 
 
 def workloads(batch: int, keys: int, total: int):
