@@ -1,0 +1,116 @@
+"""Parse scripts/ablation.log + scripts/join_probes.log into one decision
+table: per-prefix marginals, impl A/B deltas, and the join-variant ranking.
+Run after the probe watcher completes; prints markdown to stdout.
+
+Usage: python scripts/summarize_probes.py [--latest-only]
+(--latest-only keeps only rows after the last '===' run header in each log.)
+"""
+
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PREFIX_NAMES = ["source gen", "+ filter", "+ join", "+ rekey", "+ window"]
+
+
+def _read(path, latest_only):
+    try:
+        with open(os.path.join(HERE, path)) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    if latest_only:
+        for i in range(len(lines) - 1, -1, -1):
+            if lines[i].startswith("==="):
+                return lines[i:]
+    return lines
+
+
+def parse_ablation(latest_only):
+    """Returns (base_rows{n: ms}, variant_rows[(label, n, ms)]) for the LAST
+    run in the log: every '===' header resets all state, so rows from earlier
+    runs (possibly at a different batch size, appended by run_ablation.sh's
+    '>>') can never mix into one table, and a labeled probe that died without
+    printing its ABLATE line cannot leak its label onto the next run's base."""
+    base, variants = {}, []
+    label = None
+    for ln in _read("ablation.log", latest_only):
+        if ln.startswith("==="):
+            base, variants, label = {}, [], None
+            continue
+        m = re.match(r"--- (.+) prefix (\d+)", ln)
+        if m:
+            label = m.group(1)
+            continue
+        m = re.match(r"ABLATE (\d+) ([0-9.]+) ms/step", ln)
+        if m:
+            n, ms = int(m.group(1)), float(m.group(2))
+            if label is None:
+                base[n] = ms
+            else:
+                variants.append((label, n, ms))
+                label = None
+    return base, variants
+
+
+def parse_joins(latest_only):
+    out = {}
+    for ln in _read("join_probes.log", latest_only):
+        if ln.startswith("==="):
+            out = {}                      # last run only — never mix runs
+            continue
+        m = re.match(r"PROBE (\S+) ([0-9.]+) ms/step", ln)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def main():
+    latest = "--latest-only" in sys.argv
+    base, variants = parse_ablation(latest)
+    joins = parse_joins(latest)
+
+    if base:
+        print("## YSB per-prefix ablation (ms/step)\n")
+        print("| prefix | ms | marginal |")
+        print("|---|---|---|")
+        prev = 0.0
+        for n in sorted(base):
+            name = PREFIX_NAMES[n] if n < len(PREFIX_NAMES) else f"prefix {n}"
+            print(f"| {name} | {base[n]:.3f} | {base[n] - prev:+.3f} |")
+            prev = base[n]
+        print()
+    if variants:
+        print("## Impl A/B (full-chain / prefix rows, ms/step)\n")
+        print("| config | prefix | ms | vs XLA base |")
+        print("|---|---|---|---|")
+        for label, n, ms in variants:
+            b = base.get(n)
+            delta = f"{ms - b:+.3f}" if b is not None else "?"
+            print(f"| {label} | {n} | {ms:.3f} | {delta} |")
+        print()
+    if joins:
+        print("## Join variants (ms/step)\n")
+        print("| probe | ms |")
+        print("|---|---|")
+        for k, v in sorted(joins.items(), key=lambda kv: kv[1]):
+            print(f"| {k} | {v:.3f} |")
+        std = {k[len("standalone_"):]: v for k, v in joins.items()
+               if k.startswith("standalone_")}
+        pre = {k[len("prefix2_"):]: v for k, v in joins.items()
+               if k.startswith("prefix2_")}
+        b = pre.get("base")
+        if b is not None and pre:
+            print("\nper-variant IN-CHAIN marginal over prefix2_base:")
+            for k, v in sorted(pre.items(), key=lambda kv: kv[1]):
+                if k != "base":
+                    s = std.get(k)
+                    s_txt = f", standalone {s:.3f}" if s is not None else ""
+                    print(f"  {k}: {v - b:+.3f} ms{s_txt}")
+    if not (base or variants or joins):
+        print("no probe rows found (run the watcher first)")
+
+
+if __name__ == "__main__":
+    main()

@@ -136,6 +136,50 @@ def test_pallas_lookup_unblockable_capacity_falls_back():
                                   np.asarray(table)[np.asarray(idx)])
 
 
+def test_pallas_hist_fuzz_geometry():
+    """Randomized geometry x validity x placement fuzz against the scatter
+    oracle (interpret mode), inputs constructed to satisfy the fast path's
+    per-chunk locality precondition — the adoption gate for on-chip use."""
+    from windflow_tpu.ops.histogram import DEFAULT_L
+
+    rng = np.random.default_rng(42)
+    for trial in range(12):
+        chunk = DEFAULT_CHUNK
+        C = chunk * int(rng.integers(2, 9))
+        K = int(rng.integers(2, 300))
+        P = int(rng.integers(8, 4096))
+        L = DEFAULT_L
+        key = rng.integers(0, K, C).astype(np.int32)
+        # per-chunk pane base: arbitrary nondecreasing jumps (ring wraps many
+        # times); within-chunk offsets < L
+        bases = np.cumsum(rng.integers(0, 3 * P, C // chunk))
+        pane = (np.repeat(bases, chunk)
+                + rng.integers(0, L, C)).astype(np.int32)
+        valid = rng.random(C) < rng.random()
+        placement = ("ds", "mm")[trial % 2]
+        got = _call(key, pane, valid, K, P, placement=placement)
+        np.testing.assert_array_equal(
+            np.asarray(got), ref_hist(key, pane, valid, K, P),
+            err_msg=f"trial={trial} C={C} K={K} P={P} placement={placement}")
+
+
+def test_pallas_lookup_fuzz_geometry():
+    from windflow_tpu.ops.lookup import _pallas_block, _pallas_factored_lookup
+
+    rng = np.random.default_rng(43)
+    for trial in range(10):
+        K = int(rng.integers(129, 20000))
+        C = int(rng.choice([128, 256, 1024, 8192, 16384, 24576]))
+        assert _pallas_block(C), C
+        table = jnp.asarray(rng.integers(-(1 << 20), 1 << 20, K)
+                            .astype(np.int32))
+        idx = jnp.asarray(rng.integers(0, K, C).astype(np.int32))
+        got = _pallas_factored_lookup(table, idx, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(table)[np.asarray(idx)],
+            err_msg=f"trial={trial} K={K} C={C}")
+
+
 def test_pallas_odd_capacity_falls_back():
     """Non-chunk-multiple capacities route to the exact scatter path."""
     C, K, P = 1000, 3, 16
