@@ -44,8 +44,17 @@ def test_two_process_keyed_all_to_all():
     for rc, out, err in outs:
         assert rc == 0, f"driver failed (rc={rc}):\n{err[-3000:]}"
         assert "MULTIHOST-OK" in out, out
+        assert "LOSSLESS-OK" in out, out
     # both processes together received all 64 rows x 4 dp replicas; each
     # process reports its local share
     counts = [int(out.split("MULTIHOST-OK ")[1].split()[0])
               for _, out, _ in outs]
     assert sum(counts) == 64 * 4, counts
+    # lossless exchange under total key skew: every process computes the same
+    # GLOBAL delivered count (16, each row once), over more than one round
+    # (the blocking-bounded-queue path), across the real process boundary
+    lcounts = [int(out.split("LOSSLESS-OK ")[1].split()[0])
+               for _, out, _ in outs]
+    rounds = [int(out.split("rounds=")[1].split()[0]) for _, out, _ in outs]
+    assert lcounts == [16, 16], lcounts
+    assert all(r > 1 for r in rounds), rounds
