@@ -55,3 +55,33 @@ def test_ysb_per_window_counts_against_dense_oracle():
         want[(camp, wid)] = want.get((camp, wid), 0) + 1
     got = {(k, w): c for k, w, c in res}
     assert got == want
+
+
+def test_count_lift_detected_inside_chain_trace():
+    """Regression: _detect_count_lift runs INSIDE the chain's jit trace, where
+    float() on a freshly created jnp constant raises ConcretizationTypeError
+    unless evaluated under jax.ensure_compile_time_eval(). When the blanket
+    except swallowed that, the YSB windowed-count chain silently took the
+    serialized segment-sum fallback for its panes update — ~5.4 ms/step at 1M
+    batch on-chip, the whole window-stage anomaly of BASELINE.md's ablation."""
+    import jax
+    import jax.numpy as jnp
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    src = ysb.make_source(total=4 * 2048)
+    ops = ysb.make_ops(pane_capacity=16, max_wins=16)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=2048)
+    win = ops[-1]
+    assert win.count_lift is None               # not yet traced
+
+    def step(states, start):
+        b = src.make_batch(jnp.asarray(start, jnp.int32), 2048)
+        states = list(states)
+        for j, op in enumerate(chain.ops):
+            states[j], b = op.apply(states[j], b)
+        return tuple(states), jnp.sum(b.valid)
+
+    out = jax.jit(step)(tuple(chain.states), 0)
+    jax.block_until_ready(out[1])
+    assert win.count_lift is True, \
+        "count-lift fast path not detected under an ambient jit trace"

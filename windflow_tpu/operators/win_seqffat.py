@@ -453,9 +453,18 @@ def _detect_count_lift(lift, batch) -> bool:
             ts=np.zeros((), np.int32),
             data=jax.tree.map(lambda l: np.zeros(l.shape[1:], l.dtype),
                               batch.payload))
-        out = jax.tree.leaves(lift(zero))
-        return (len(out) == 1 and np.shape(out[0]) == ()
-                and float(out[0]) == 1.0)
+        # Detection runs INSIDE the chain's jit trace, where every jnp op —
+        # even a constant like jnp.ones(()) — returns a tracer of the ambient
+        # trace and float() raises ConcretizationTypeError. Without the escape
+        # hatch the blanket except returned False and the YSB/windowed-count
+        # chain silently took the serialized segment-sum fallback for the
+        # panes update (~5.4 ms/step at 1M batch, the whole window-stage
+        # anomaly of BASELINE.md's ablation); standalone probes passed
+        # detection and never saw it.
+        with jax.ensure_compile_time_eval():
+            out = jax.tree.leaves(lift(zero))
+            return (len(out) == 1 and np.shape(out[0]) == ()
+                    and float(out[0]) == 1.0)
     except Exception:
         return False
 
