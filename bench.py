@@ -180,21 +180,38 @@ def _roofline(step_jitted, args, step_s):
     return out
 
 
-def _bench_loop(step, states, n_steps, batch, reps: int = 1):
-    """Time ``n_steps`` async-dispatched steps; with ``reps`` > 1 return the
-    median rep (dispatch-pipelining jitter on the tunneled link is large when
-    steps are fast). The caller's source must cover reps*n_steps+1 batches."""
+def _cursor_bench(chain, src, batch: int = None):
+    """The one recipe for a timed chain bench: shared device-cursor step +
+    lowering specs (a ShapeDtypeStruct cursor spec — no device array is
+    materialized over the flaky link just to read a shape)."""
     import jax
+    import jax.numpy as jnp
+    from windflow_tpu.benchmarks import device_cursor_step
+    step = device_cursor_step(chain, src, batch or BATCH)
+    specs = _arg_specs((tuple(chain.states),
+                        jax.ShapeDtypeStruct((), jnp.int32)))
+    return step, specs
+
+
+def _bench_loop(step, states, n_steps, reps: int = 1):
+    """Time ``n_steps`` async-dispatched steps of a device-cursor step
+    (``step(states, cur) -> (states, cur + batch, out)`` — see
+    ``windflow_tpu.benchmarks.device_cursor_step``); with ``reps`` > 1 return
+    the median rep (dispatch-pipelining jitter on the tunneled link is large
+    when steps are fast). The caller's source must cover reps*n_steps+1
+    batches. The cursor stays on device, so no bench row carries a per-step
+    host-scalar upload."""
+    import jax
+    import jax.numpy as jnp
+    cur = jnp.asarray(0, jnp.int32)
     # warmup/compile
-    states, out = step(states, 0)
+    states, cur, out = step(states, cur)
     jax.block_until_ready(out)
     times = []
-    pos = 1
     for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(n_steps):
-            states, out = step(states, pos * batch)
-            pos += 1
+            states, cur, out = step(states, cur)
             # async dispatch: the host enqueues step i+1 while the device runs i
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
@@ -215,16 +232,8 @@ def bench_ysb():
                        max_wins=panes_per_batch + 64)
     chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
 
-    def step(states, start):
-        batch = src.make_batch(jnp.asarray(start, jnp.int32), BATCH)
-        states = list(states)
-        for j, op in enumerate(chain.ops):
-            states[j], batch = op.apply(states[j], batch)
-        return tuple(states), batch.valid
-
-    step = jax.jit(step, donate_argnums=0)
-    specs = _arg_specs((tuple(chain.states), 0))
-    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH)
+    step, specs = _cursor_bench(chain, src)
+    dt, _ = _bench_loop(step, tuple(chain.states), STEPS)
     roof = _roofline(step, specs, dt / STEPS)
     return STEPS * BATCH / dt, dt / STEPS, roof
 
@@ -246,16 +255,8 @@ def bench_stateless():
            ReduceSink(lambda t: t.v)]
     chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
 
-    def step(states, start):
-        batch = src.make_batch(jnp.asarray(start, jnp.int32), BATCH)
-        states = list(states)
-        for j, op in enumerate(chain.ops):
-            states[j], batch = op.apply(states[j], batch)
-        return tuple(states), batch.valid
-
-    step = jax.jit(step, donate_argnums=0)
-    specs = _arg_specs((tuple(chain.states), 0))
-    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH)
+    step, specs = _cursor_bench(chain, src)
+    dt, _ = _bench_loop(step, tuple(chain.states), STEPS)
     roof = _roofline(step, specs, dt / STEPS)
     return STEPS * BATCH / dt, dt / STEPS, roof
 
@@ -277,16 +278,8 @@ def bench_keyed_cb():
                   spec=WindowSpec(1024, 512), num_keys=K)
     chain = CompiledChain([op], src.payload_spec(), batch_capacity=BATCH)
 
-    def step(states, start):
-        batch = src.make_batch(jnp.asarray(start, jnp.int32), BATCH)
-        states = list(states)
-        for j, o in enumerate(chain.ops):
-            states[j], batch = o.apply(states[j], batch)
-        return tuple(states), batch.valid
-
-    step = jax.jit(step, donate_argnums=0)
-    specs = _arg_specs((tuple(chain.states), 0))
-    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH, reps=reps)
+    step, specs = _cursor_bench(chain, src)
+    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, reps=reps)
     roof = _roofline(step, specs, dt / STEPS)
     return STEPS * BATCH / dt, dt / STEPS, roof
 
@@ -411,15 +404,8 @@ def bench_keyed_stateful(num_keys: int):
            ReduceSink(lambda t: t.data)]
     chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
 
-    def step(states, start):
-        batch = src.make_batch(jnp.asarray(start, jnp.int32), BATCH)
-        states = list(states)
-        for j, o in enumerate(chain.ops):
-            states[j], batch = o.apply(states[j], batch)
-        return tuple(states), batch.valid
-
-    step = jax.jit(step, donate_argnums=0)
-    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, BATCH, reps=reps)
+    step, _ = _cursor_bench(chain, src)
+    dt, _ = _bench_loop(step, tuple(chain.states), STEPS, reps=reps)
     return STEPS * BATCH / dt, dt / STEPS
 
 

@@ -1,14 +1,15 @@
 #!/bin/bash
-# r05 probe watcher: the YSB headline is already captured fresh this round
-# (bench_captures/last_good.json, 2026-07-31T03:48Z). What the next tunnel
-# window is FOR is diagnosis: the per-prefix ablation and the join-variant
-# probes that decide the next perf fix. Probe every 120s; on first success run
-# ablation -> join probes -> keyed_cb refresh (for the roofline overcount
-# annotation). Logs: scripts/tunnel_watch.log, scripts/ablation.log,
+# r05 probe watcher v2. The count-lift detection fix (commit 81f602a) is
+# expected to collapse the YSB window stage (step ~8.1 -> ~3.1 ms), so the
+# FIRST action on the next tunnel window is a fresh YSB headline capture —
+# persisted immediately in case the window is short. Then the diagnosis
+# probes (per-prefix ablation — whose runner also refreshes the isolated
+# stateless row — then join variants), then the isolated keyed_cb refresh.
+# Probe every 120s. Logs: scripts/tunnel_watch.log, scripts/ablation.log,
 # scripts/join_probes.log.
 cd /root/repo
 LOG=scripts/tunnel_watch.log
-echo "$(date -u +%FT%TZ) probe-watcher start" >> "$LOG"
+echo "$(date -u +%FT%TZ) probe-watcher-v2 start" >> "$LOG"
 while true; do
   if timeout 90 python -c "
 import jax, jax.numpy as jnp
@@ -17,12 +18,26 @@ x = jax.device_put(jnp.ones((1024,), jnp.float32))
 assert float((x*2).sum()) == 2048.0
 print('probe ok:', d)
 " >> "$LOG" 2>&1; then
-    echo "$(date -u +%FT%TZ) TUNNEL UP — running r05 probes" >> "$LOG"
+    echo "$(date -u +%FT%TZ) TUNNEL UP — capturing post-fix YSB headline" >> "$LOG"
     break
   fi
   echo "$(date -u +%FT%TZ) probe failed/hung" >> "$LOG"
   sleep 120
 done
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+timeout 1200 python -c "
+import bench
+tps, step, roof = bench.bench_ysb()
+bench.record('ysb', {'tps': tps, 'step_s': step, 'batch': bench.BATCH,
+                     'roofline': roof}, methodology='watcher-standalone')
+bench.record_headline({'metric': 'YSB tuples/sec/chip', 'value': round(tps),
+                       'unit': 'tuples/s',
+                       'vs_baseline': round(tps / bench.BASELINE_TPS, 3)},
+                      methodology='watcher-standalone')
+print('YSB post-count-lift-fix:', tps / 1e6, 'M t/s,', step * 1e3, 'ms/step')
+" > "scripts/capture_r05_ysb_postfix_$STAMP.log" 2>&1
+rc=$?   # BEFORE any $(...) — a command substitution would clobber $?
+echo "$(date -u +%FT%TZ) post-fix ysb done rc=$rc ($(tail -1 scripts/capture_r05_ysb_postfix_$STAMP.log))" >> "$LOG"
 bash scripts/run_ablation.sh
 echo "$(date -u +%FT%TZ) ablation done" >> "$LOG"
 bash scripts/run_join_probes.sh
@@ -34,4 +49,4 @@ bench.record('keyed_cb', {'tps': r[0], 'step_s': r[1], 'roofline': r[2]},
              methodology='isolated-subprocess')
 print('keyed_cb refreshed', r[0]/1e6)
 " >> "$LOG" 2>&1
-echo "$(date -u +%FT%TZ) probe-watcher done" >> "$LOG"
+echo "$(date -u +%FT%TZ) probe-watcher-v2 done" >> "$LOG"
