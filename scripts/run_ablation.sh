@@ -1,19 +1,26 @@
 #!/bin/bash
 # Per-prefix YSB ablation, one fresh process per prefix (r03 integrity rule).
 # Results append to scripts/ablation.log. Usage: run_ablation.sh [batch]
+# Exits 3 (via ok_or_bail) if the tunnel dies mid-run — callers must check.
 cd /root/repo
 LOG=scripts/ablation.log
+. scripts/tunnel_lib.sh
 echo "=== $(date -u +%FT%TZ) batch=${1:-1048576}" >> "$LOG"
+
 for n in 0 1 2 3 4; do
   # HLO dumps for the join/rekey/window prefixes: the fusion diff between
   # hlo_ablate_3 and hlo_ablate_4 is the in-chain-slowdown evidence
   dump=""; [ "$n" -ge 2 ] && dump="WF_DUMP_HLO=1"
   env $dump timeout 900 python scripts/probe_ysb_ablation.py "$n" "${1:-1048576}" >> "$LOG" 2>&1
+  ok_or_bail $? "$LOG"
 done
+
 # Mosaic lowering precheck on tiny shapes, one fresh short-timeout process per
 # kernel: a variant whose store pattern Mosaic refuses (the "ds" dynamic
 # minor-dim slice is the suspect) must fail HERE in seconds, not burn a
-# 900 s probe slot mid-window. Probes below only run for variants that pass.
+# 900 s probe slot mid-window. A precheck failure is only recorded as a
+# lowering verdict when the tunnel is still alive (ok_or_bail distinguishes);
+# probes below only run for variants that pass.
 hist_ok=""
 for pv in ds mm; do
   if timeout 300 python -c "
@@ -26,7 +33,8 @@ got = keyed_pane_histogram_pallas(key, pane, valid, 8, 32, placement='$pv')
 assert (np.asarray(got) == np.asarray(_scatter_hist(key, pane, valid, 8, 32))).all()
 print('hist $pv lowers + matches')
 " >> "$LOG" 2>&1; then hist_ok="$hist_ok $pv"; else
-    echo "PRECHECK hist $pv FAILED (skipping its probes)" >> "$LOG"; fi
+    ok_or_bail 1 "$LOG"
+    echo "PRECHECK hist $pv FAILED with the tunnel alive (Mosaic verdict; skipping its probes)" >> "$LOG"; fi
 done
 lookup_ok=0
 if timeout 300 python -c "
@@ -38,7 +46,8 @@ got = _pallas_factored_lookup(t, i)
 assert (np.asarray(got) == np.asarray(t)[np.asarray(i)]).all()
 print('lookup pallas lowers + matches')
 " >> "$LOG" 2>&1; then lookup_ok=1; else
-  echo "PRECHECK lookup pallas FAILED (skipping its probes)" >> "$LOG"; fi
+  ok_or_bail 1 "$LOG"
+  echo "PRECHECK lookup pallas FAILED with the tunnel alive (Mosaic verdict; skipping its probes)" >> "$LOG"; fi
 
 # Decisive cond-flattening diagnostic: if prefix 4 collapses with the locality
 # cond bypassed, the serialized scatter FALLBACK branch was executing every
@@ -46,6 +55,7 @@ print('lookup pallas lowers + matches')
 # structure, not the fast path.
 echo "--- WF_HISTOGRAM_FORCE_FAST=1 prefix 4" >> "$LOG"
 WF_HISTOGRAM_FORCE_FAST=1 timeout 900 python scripts/probe_ysb_ablation.py 4 "${1:-1048576}" >> "$LOG" 2>&1
+ok_or_bail $? "$LOG"
 
 # Pallas-impl A/Bs against the XLA ABLATE rows above, one fresh process each:
 # window-insert kernel alone, join kernel alone, and the all-Pallas chain.
@@ -54,14 +64,17 @@ for pv in $hist_ok; do
   impl=pallas; [ "$pv" = mm ] && impl=pallas_mm
   echo "--- WF_HISTOGRAM_IMPL=$impl prefix 4" >> "$LOG"
   WF_HISTOGRAM_IMPL=$impl timeout 900 python scripts/probe_ysb_ablation.py 4 "${1:-1048576}" >> "$LOG" 2>&1
+  ok_or_bail $? "$LOG"
   best_hist=$impl
 done
 if [ "$lookup_ok" = 1 ]; then
   echo "--- WF_LOOKUP_IMPL=pallas prefix 2" >> "$LOG"
   WF_LOOKUP_IMPL=pallas timeout 900 python scripts/probe_ysb_ablation.py 2 "${1:-1048576}" >> "$LOG" 2>&1
+  ok_or_bail $? "$LOG"
   if [ -n "$best_hist" ]; then
     echo "--- both pallas prefix 4 (hist=$best_hist)" >> "$LOG"
     WF_LOOKUP_IMPL=pallas WF_HISTOGRAM_IMPL=$best_hist timeout 900 python scripts/probe_ysb_ablation.py 4 "${1:-1048576}" >> "$LOG" 2>&1
+    ok_or_bail $? "$LOG"
   fi
 fi
 # refresh the stateless capture under process isolation: the in-session row
@@ -72,4 +85,5 @@ import bench
 r = bench.capture_stateless_isolated()
 print('stateless isolated:', r[0] / 1e6, 'M t/s,', r[1] * 1e3, 'ms/step')
 " >> "$LOG" 2>&1
+ok_or_bail $? "$LOG"
 tail -22 "$LOG"

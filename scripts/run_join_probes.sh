@@ -1,8 +1,10 @@
 #!/bin/bash
 # One fresh process per probe (the r03 measurement-integrity rule); run on the
 # real chip when the tunnel is up. Results append to scripts/join_probes.log.
+# Exits 3 (via ok_or_bail) if the tunnel dies mid-run — callers must check.
 cd /root/repo
 LOG=scripts/join_probes.log
+. scripts/tunnel_lib.sh
 echo "=== $(date -u +%FT%TZ) batch=${1:-1048576}" >> "$LOG"
 for p in prefix2_base prefix2_factored prefix2_factored_bf16 prefix2_take \
          prefix2_barrier prefix2_div prefix2_pallas_gather \
@@ -12,5 +14,6 @@ for p in prefix2_base prefix2_factored prefix2_factored_bf16 prefix2_take \
   dump=""
   case "$p" in prefix2_base|prefix2_factored|standalone_factored) dump="WF_DUMP_HLO=1";; esac
   env $dump timeout 900 python scripts/probe_join.py "$p" "${1:-1048576}" >> "$LOG" 2>&1
+  ok_or_bail $? "$LOG"
 done
 tail -16 "$LOG"

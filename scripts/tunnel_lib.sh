@@ -1,0 +1,26 @@
+# Shared tunnel-liveness helpers, sourced by the probe runners and watcher.
+#
+# alive: one short device round trip (timeout 90 — platform init over the
+#   tunnel can take 60-90 s; the watcher's historical probe uses the same
+#   budget). Returns nonzero when the link is down.
+# ok_or_bail <rc> <log>: cheap gating policy — only when the PREVIOUS command
+#   failed do we spend an alive round trip to distinguish "probe bug" from
+#   "tunnel died"; a probe that just succeeded proves the link was up seconds
+#   ago. On a dead link, logs TUNNEL DIED and exits 3 (callers must check).
+
+alive() {
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jax.device_put(jnp.ones((1024,), jnp.float32))
+assert float((x*2).sum()) == 2048.0" >/dev/null 2>&1
+}
+
+ok_or_bail() {
+  local rc="$1" log="$2"
+  [ "$rc" -eq 0 ] && return 0
+  if ! alive; then
+    echo "TUNNEL DIED mid-run $(date -u +%FT%TZ) — aborting remaining probes" >> "$log"
+    exit 3
+  fi
+  return 0          # probe failed but link is up: a real (reportable) failure
+}

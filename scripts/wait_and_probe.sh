@@ -39,9 +39,19 @@ print('YSB post-count-lift-fix:', tps / 1e6, 'M t/s,', step * 1e3, 'ms/step')
 rc=$?   # BEFORE any $(...) — a command substitution would clobber $?
 echo "$(date -u +%FT%TZ) post-fix ysb done rc=$rc ($(tail -1 scripts/capture_r05_ysb_postfix_$STAMP.log))" >> "$LOG"
 bash scripts/run_ablation.sh
-echo "$(date -u +%FT%TZ) ablation done" >> "$LOG"
+rc=$?
+echo "$(date -u +%FT%TZ) ablation done rc=$rc" >> "$LOG"
+if [ "$rc" -eq 3 ]; then
+  echo "$(date -u +%FT%TZ) tunnel died mid-ablation — watcher exiting (relaunch to retry)" >> "$LOG"
+  exit 3
+fi
 bash scripts/run_join_probes.sh
-echo "$(date -u +%FT%TZ) join probes done" >> "$LOG"
+rc=$?
+echo "$(date -u +%FT%TZ) join probes done rc=$rc" >> "$LOG"
+if [ "$rc" -eq 3 ]; then
+  echo "$(date -u +%FT%TZ) tunnel died mid-join-probes — watcher exiting (relaunch to retry)" >> "$LOG"
+  exit 3
+fi
 timeout 900 python -c "
 import bench
 r = bench._run_isolated('bench_keyed_cb()')
