@@ -238,6 +238,63 @@ def bench_ysb():
     return STEPS * BATCH / dt, dt / STEPS, roof
 
 
+def bench_ysb_wmr(map_parallelism: int = 4):
+    """YSB with the Win_MapReduce window stage — the reference's other
+    headline YSB pipeline (``src/yahoo_test_cpu/test_ysb_wmr.cpp``: each
+    window's content partitioned over MAP workers, partial counts combined by
+    REDUCE). Same source/filter/join prefix as bench_ysb.
+
+    Geometry is WMR-appropriate, not Key_FFAT's: Win_MapReduce rides the
+    gather-based Win_Seq engine whose TB emission gathers the FULL per-key
+    ring per fired window (L = tb_capacity) and whose fired-window budget W is
+    SHARED across all keys — at the FFAT bench's win_len=100 that is ~105k
+    fired windows x the ring per batch, infeasible by design (WMR is the
+    reference's pattern for FEW, LARGE windows; per-pane counting is what
+    Key_FFAT is for). win_len = 1000 ticks gives ~1 window/key/batch:
+    W = num_keys * (windows/batch + margin), ring = 8192 > per-key window
+    span (~3.3k tuples) + one batch of arrivals (~3.5k).
+
+    The run self-checks exactness: the summed window counts must cover the
+    views of every COMPLETED window; a mis-sized budget (deferral collapse or
+    ring overwrite) undercounts and raises instead of reporting a degenerate
+    pipeline's throughput."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from windflow_tpu.benchmarks import ysb
+    from windflow_tpu.operators.sink import ReduceSink
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    WIN_LEN = 1000                       # ticks; 10x the FFAT bench's windows
+    wins_per_batch = BATCH // (ysb.EVENTS_PER_TICK * WIN_LEN) + 1
+    src = ysb.make_source(total=(STEPS + 2) * BATCH)
+    ops = ysb.make_ops_wmr(win_len=WIN_LEN,
+                           map_parallelism=map_parallelism,
+                           max_wins=ysb.N_CAMPAIGNS * (wins_per_batch + 2),
+                           tb_capacity=8192)
+    ops.append(ReduceSink(lambda t: t.data, name="wmr_total"))
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+
+    step, specs = _cursor_bench(chain, src)
+    dt, states = _bench_loop(step, tuple(chain.states), STEPS)
+    # exactness self-check: every window whose span is fully delivered AND
+    # past the flush horizon must have fired with its full count. After
+    # n_batches = STEPS+1 (incl. warmup), ticks delivered = n*BATCH/RATE;
+    # completed windows cover ticks [0, floor(.../WIN_LEN)*WIN_LEN); views in
+    # that range = ceil(ticks*RATE/3) (every 3rd global index is a view).
+    total = int(np.asarray(jax.tree.leaves(states[-1])[0]))
+    ticks = (STEPS + 1) * BATCH // ysb.EVENTS_PER_TICK
+    complete_ticks = (ticks // WIN_LEN - 1) * WIN_LEN   # -1: delay horizon
+    expect_min = (complete_ticks * ysb.EVENTS_PER_TICK + 2) // 3
+    if total < expect_min:
+        raise RuntimeError(
+            f"bench_ysb_wmr undercounted: {total} < {expect_min} views over "
+            f"completed windows — budget/ring mis-sized, refusing to report "
+            f"a degenerate pipeline")
+    roof = _roofline(step, specs, dt / STEPS)
+    return STEPS * BATCH / dt, dt / STEPS, roof
+
+
 def bench_stateless():
     """Config 2 of BASELINE.json: Source->Map->Filter->Sink micro-batch."""
     import jax
@@ -975,6 +1032,12 @@ def _secondary_benches(ysb_tps, ysb_step_s):
             print(f"keyed-stateful map (K={k}): {ks_tps/1e6:.2f} M tuples/s "
                   f"({ks_step*1e3:.2f} ms/step)  [CUDA bar: 0.44-0.64M @1, "
                   f"11.8M @500, 10M @10k]", file=sys.stderr)
+        wm_tps, wm_step, wm_roof = _run_isolated("bench_ysb_wmr()")
+        record("ysb_wmr", {"tps": wm_tps, "step_s": wm_step,
+                           "roofline": wm_roof},
+               methodology="isolated-subprocess")
+        print(f"YSB Win_MapReduce variant (M=4): {wm_tps/1e6:.2f} M tuples/s "
+              f"({wm_step*1e3:.2f} ms/step)", file=sys.stderr)
         od_tps, oo_tps, oratio = _run_isolated("bench_ordering_overhead()")
         record("ordering_overhead", {"default_tps": od_tps,
                                      "deterministic_tps": oo_tps,

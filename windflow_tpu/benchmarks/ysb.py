@@ -68,17 +68,19 @@ def make_ops(num_keys: int = N_CAMPAIGNS, win_len: int = WIN_LEN,
 
 
 def make_ops_wmr(num_keys: int = N_CAMPAIGNS, win_len: int = WIN_LEN,
-                 map_parallelism: int = 2):
+                 map_parallelism: int = 2, **engine_kw):
     """YSB with a Win_MapReduce window stage — the ``test_ysb_wmr.cpp`` variant of
     the reference (each window's content partitioned over MAP workers, partial
-    counts combined by REDUCE)."""
+    counts combined by REDUCE). ``engine_kw`` (``max_wins``, ``tb_capacity``,
+    ...) forwards to the underlying Win_Seq engine — large batches need
+    explicit fired-window budgets (the engine's default budget guard raises)."""
     from ..operators.win_patterns import Win_MapReduce
     filt, join, rekey, _ = make_ops(num_keys=num_keys, win_len=win_len)
     window = Win_MapReduce(lambda wid, it: it.size(),
                            lambda wid, it: it.sum(),
                            WindowSpec(win_len, win_len, win_type_t.TB),
                            map_parallelism=map_parallelism, num_keys=num_keys,
-                           name="ysb_window_wmr")
+                           name="ysb_window_wmr", **engine_kw)
     return [filt, join, rekey, window]
 
 
