@@ -180,6 +180,22 @@ def _roofline(step_jitted, args, step_s):
     return out
 
 
+def _chain_metrics(chain, step_s: float = None) -> dict:
+    """Graph-level metrics snapshot of one bench chain — attached to every
+    persisted capture so BENCH_r*.json carry per-stage evidence (operator
+    structure, routing, counters, service-time percentiles) instead of one
+    opaque number. The cursor loop bypasses ``chain.push``, so the measured
+    per-step time is fed to the entry op's Stats_Record first — the same
+    attribution convention as CompiledChain.push (ONE fused program, one
+    launch sample credited to the entry op)."""
+    from windflow_tpu.observability import MetricsRegistry
+    if step_s is not None and chain.ops:
+        chain.ops[0].get_StatsRecords()[0].record_launch(step_s)
+    reg = MetricsRegistry("bench")
+    reg.register_chain("chain", chain)
+    return reg.snapshot()
+
+
 def _cursor_bench(chain, src, batch: int = None):
     """The one recipe for a timed chain bench: shared device-cursor step +
     lowering specs (a ShapeDtypeStruct cursor spec — no device array is
@@ -235,7 +251,7 @@ def bench_ysb():
     step, specs = _cursor_bench(chain, src)
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS)
     roof = _roofline(step, specs, dt / STEPS)
-    return STEPS * BATCH / dt, dt / STEPS, roof
+    return STEPS * BATCH / dt, dt / STEPS, roof, _chain_metrics(chain, dt / STEPS)
 
 
 def bench_ysb_wmr(map_parallelism: int = 4):
@@ -292,7 +308,7 @@ def bench_ysb_wmr(map_parallelism: int = 4):
             f"completed windows — budget/ring mis-sized, refusing to report "
             f"a degenerate pipeline")
     roof = _roofline(step, specs, dt / STEPS)
-    return STEPS * BATCH / dt, dt / STEPS, roof
+    return STEPS * BATCH / dt, dt / STEPS, roof, _chain_metrics(chain, dt / STEPS)
 
 
 def bench_stateless():
@@ -315,7 +331,7 @@ def bench_stateless():
     step, specs = _cursor_bench(chain, src)
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS)
     roof = _roofline(step, specs, dt / STEPS)
-    return STEPS * BATCH / dt, dt / STEPS, roof
+    return STEPS * BATCH / dt, dt / STEPS, roof, _chain_metrics(chain, dt / STEPS)
 
 
 def bench_keyed_cb():
@@ -338,7 +354,7 @@ def bench_keyed_cb():
     step, specs = _cursor_bench(chain, src)
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS, reps=reps)
     roof = _roofline(step, specs, dt / STEPS)
-    return STEPS * BATCH / dt, dt / STEPS, roof
+    return STEPS * BATCH / dt, dt / STEPS, roof, _chain_metrics(chain, dt / STEPS)
 
 
 def measure_floor():
@@ -720,9 +736,20 @@ def bench_drive_loop(batches=(4096, 262144, 1 << 20),
         # masquerade as per-batch cost and over-shrink the row.
         pilot_a = run_graph(4)                # warms persistent XLA caches
         pilot_a = min(pilot_a, run_graph(4))
-        pilot_b = run_graph(12)
+        # pilot_b: min-of-2 like pilot_a — a single noisy run on the tunneled
+        # link can come in FASTER than pilot_a, and the old negative delta
+        # clamped to per_batch_est=1e-7 concluded ~zero cost, skipped scaling,
+        # and burned the whole isolation slot (ADVICE r05 #3)
+        pilot_b = min(run_graph(12), run_graph(12))
         budget_s = float(os.environ.get("WF_DRIVE_LOOP_BUDGET_S", 240))
-        per_batch_est = max((pilot_b - pilot_a) / 8, 1e-7)
+        pilot_failed = (pilot_b - pilot_a) <= 0.0
+        if pilot_failed:
+            # estimate failed (noise >= signal): conservative default — charge
+            # the WHOLE warm pilot as per-batch cost so the budget check
+            # over-protects the slot instead of under-protecting it
+            per_batch_est = max(pilot_a / 4, 1e-7)
+        else:
+            per_batch_est = (pilot_b - pilot_a) / 8
         overhead_est = max(pilot_a - 4 * per_batch_est, 0.0)  # compile+trace
         n2_orig = n2
         spend = 5 * overhead_est + per_batch_est * (4 * n2 + 2 * n1)
@@ -762,6 +789,7 @@ def bench_drive_loop(batches=(4096, 262144, 1 << 20),
         drv_us = per_batch_s * 1e6 - step_us
         rows.append({
             "batch": B, "n1": n1, "n2": n2,
+            "pilot_estimate_failed": pilot_failed,
             "scaled_for_budget": (round(n2 / n2_orig, 4)
                                   if n2 < n2_orig else None),
             "driver_wall_us_per_batch": round(per_batch_s * 1e6, 1),
@@ -953,14 +981,14 @@ def main():
     # crashing: the tunnel dying MID-run must not erase a fresh YSB number
     # (it erased the whole r03 capture).
     try:
-        ysb_tps, ysb_step_s, ysb_roof = bench_ysb()
+        ysb_tps, ysb_step_s, ysb_roof, ysb_metrics = bench_ysb()
     except Exception as e:  # noqa: BLE001 — device death mid-run
         import traceback
         traceback.print_exc()
         sys.exit(emit_stale_headline(
             f"bench_ysb failed after a passing healthcheck: {e}"))
     record("ysb", {"tps": ysb_tps, "step_s": ysb_step_s, "batch": BATCH,
-                   "roofline": ysb_roof})
+                   "roofline": ysb_roof, "metrics": ysb_metrics})
     if "error" not in ysb_roof:
         print(f"YSB roofline: {ysb_roof['achieved_hbm_gbps']} GB/s HBM "
               f"({ysb_roof['hbm_utilization_pct']}% of peak), "
@@ -991,9 +1019,10 @@ def capture_stateless_isolated():
     dispatch degradation (r03 finding), not the program: the 2026-07-31
     in-session capture read 1.83 ms/step at 0.07% HBM utilization for a
     map+filter whose traffic bound is ~50 us."""
-    sl_tps, sl_step_s, sl_roof = _run_isolated("bench_stateless()")
+    sl_tps, sl_step_s, sl_roof, sl_metrics = _run_isolated("bench_stateless()")
     record("stateless", {"tps": sl_tps, "step_s": sl_step_s, "batch": BATCH,
-                         "roofline": sl_roof}, methodology="isolated-subprocess")
+                         "roofline": sl_roof, "metrics": sl_metrics},
+           methodology="isolated-subprocess")
     return sl_tps, sl_step_s, sl_roof
 
 
@@ -1004,8 +1033,9 @@ def _secondary_benches(ysb_tps, ysb_step_s):
     print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "
           f"({sl_step_s*1e3:.2f} ms/step; roofline "
           f"{sl_roof.get('hbm_utilization_pct', '?')}% HBM)", file=sys.stderr)
-    kc_tps, kc_step, kc_roof = _run_isolated("bench_keyed_cb()")
-    record("keyed_cb", {"tps": kc_tps, "step_s": kc_step, "roofline": kc_roof},
+    kc_tps, kc_step, kc_roof, kc_metrics = _run_isolated("bench_keyed_cb()")
+    record("keyed_cb", {"tps": kc_tps, "step_s": kc_step, "roofline": kc_roof,
+                        "metrics": kc_metrics},
            methodology="isolated-subprocess")
     print(f"keyed CB sliding windows (K=512, w=1024 s=512): "
           f"{kc_tps/1e6:.2f} M tuples/s ({kc_step*1e3:.2f} ms/step)",
@@ -1032,9 +1062,9 @@ def _secondary_benches(ysb_tps, ysb_step_s):
             print(f"keyed-stateful map (K={k}): {ks_tps/1e6:.2f} M tuples/s "
                   f"({ks_step*1e3:.2f} ms/step)  [CUDA bar: 0.44-0.64M @1, "
                   f"11.8M @500, 10M @10k]", file=sys.stderr)
-        wm_tps, wm_step, wm_roof = _run_isolated("bench_ysb_wmr()")
+        wm_tps, wm_step, wm_roof, wm_metrics = _run_isolated("bench_ysb_wmr()")
         record("ysb_wmr", {"tps": wm_tps, "step_s": wm_step,
-                           "roofline": wm_roof},
+                           "roofline": wm_roof, "metrics": wm_metrics},
                methodology="isolated-subprocess")
         print(f"YSB Win_MapReduce variant (M=4): {wm_tps/1e6:.2f} M tuples/s "
               f"({wm_step*1e3:.2f} ms/step)", file=sys.stderr)

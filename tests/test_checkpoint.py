@@ -84,3 +84,69 @@ def test_checkpoint_rescale_across_meshes(tmp_path):
     leaves = [l for l in jax.tree.leaves(c4.states[0])
               if getattr(l, "ndim", 0) >= 1 and l.shape[0] == K]
     assert leaves and len({s.device for s in leaves[0].addressable_shards}) == 4
+
+
+def test_load_chain_legacy_checkpoint_missing_trailing_leaves(tmp_path):
+    """A checkpoint written before a state dataclass grew a trailing field
+    (Win_SeqFFAT.dropped_old) restores with the missing leaves at their
+    freshly-initialized values instead of raising KeyError."""
+    import numpy as np
+    import windflow_tpu as wf
+    from windflow_tpu.basic import win_type_t
+    from windflow_tpu.operators.source import DeviceSource
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    def mk_chain():
+        src = DeviceSource(lambda i: {"v": (i % 7).astype(jnp.float32)},
+                           total=512, num_keys=4)
+        op = wf.Win_SeqFFAT(lambda t: 1, jnp.add,
+                            spec=wf.WindowSpec(8, 8, win_type_t.TB),
+                            num_keys=4, pane_capacity=64)
+        return src, CompiledChain([op], src.payload_spec(), batch_capacity=64)
+
+    src, c1 = mk_chain()
+    for b in src.batches(64):
+        c1.push(b)
+        break
+    ckpt = str(tmp_path / "legacy.npz")
+    save_chain(c1, ckpt, meta={"v": 1})
+    # simulate the pre-dropped_old format: strip the trailing leaf
+    data = dict(np.load(ckpt))
+    n_leaves = len([k for k in data if k.startswith("op0_leaf")])
+    del data[f"op0_leaf{n_leaves - 1}"]
+    np.savez(ckpt, **data)
+
+    _, c2 = mk_chain()
+    meta = load_chain(c2, ckpt)
+    assert meta == {"v": 1}
+    st = c2.states[0]
+    assert int(np.asarray(st.dropped_old)) == 0          # defaulted, not KeyError
+    np.testing.assert_array_equal(np.asarray(st.cnt),
+                                  np.asarray(c1.states[0].cnt))
+
+
+def test_load_chain_gap_in_leaves_still_raises(tmp_path):
+    """Only a missing TRAILING suffix is tolerated (legacy grown field); a gap
+    — missing leaf with later leaves present — is a mismatched/truncated
+    checkpoint and must stay a loud error, not a silent partial restore."""
+    import numpy as np
+    import pytest
+    import windflow_tpu as wf
+    from windflow_tpu.operators.source import DeviceSource
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    from windflow_tpu.basic import win_type_t
+    src = DeviceSource(lambda i: {"v": (i % 7).astype(jnp.float32)},
+                       total=512, num_keys=4)
+    op = wf.Win_SeqFFAT(lambda t: 1, jnp.add,
+                        spec=wf.WindowSpec(8, 8, win_type_t.TB),
+                        num_keys=4, pane_capacity=64)
+    chain = CompiledChain([op], src.payload_spec(), batch_capacity=64)
+    ckpt = str(tmp_path / "gap.npz")
+    save_chain(chain, ckpt)
+    data = dict(np.load(ckpt))
+    assert "op0_leaf2" in data          # multi-leaf state: gap constructible
+    del data["op0_leaf0"]               # drop leaf 0, keep later leaves
+    np.savez(ckpt, **data)
+    with pytest.raises(KeyError, match="missing op0_leaf0"):
+        load_chain(chain, ckpt)

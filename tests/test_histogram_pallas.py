@@ -190,3 +190,43 @@ def test_pallas_odd_capacity_falls_back():
     got = _call(key, pane, valid, K, P)
     np.testing.assert_array_equal(np.asarray(got),
                                   ref_hist(key, pane, valid, K, P))
+
+
+def test_pallas_small_ring_routes_to_scatter():
+    """ring < locality: the kernel's single-fold wrap is shape-mismatched, so
+    the call must route to the exact scatter path (ADVICE r05 #2)."""
+    C, K, P = 2048, 5, 4                     # P=4 < locality=8
+    rng = np.random.default_rng(3)
+    key = rng.integers(0, K, C).astype(np.int32)
+    pane = rng.integers(0, 64, C).astype(np.int32)
+    valid = rng.random(C) < 0.9
+    got = _call(key, pane, valid, K, P)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  ref_hist(key, pane, valid, K, P))
+    # the integrated entry point with impl="pallas" takes the same route
+    got2 = keyed_pane_histogram(jnp.asarray(key), jnp.asarray(pane),
+                                jnp.asarray(valid), K, P, impl="pallas")
+    np.testing.assert_array_equal(np.asarray(got2),
+                                  ref_hist(key, pane, valid, K, P))
+
+
+def test_histogram_force_fast_env_zero_means_off(monkeypatch):
+    """WF_HISTOGRAM_FORCE_FAST='0'/'' must DISABLE the diagnostic bypass (the
+    WF_ORDERING_SKIP_SORTED convention, ADVICE r05 #1): with the locality cond
+    active, a locality-violating batch still takes the exact scatter branch."""
+    C, K, P = 2048, 4, 32
+    rng = np.random.default_rng(9)
+    key = rng.integers(0, K, C).astype(np.int32)
+    pane = rng.integers(0, 10_000, C).astype(np.int32)   # wildly out of locality
+    valid = np.ones(C, bool)
+    oracle = ref_hist(key, pane, valid, K, P)
+    for off in ("0", ""):
+        monkeypatch.setenv("WF_HISTOGRAM_FORCE_FAST", off)
+        got = keyed_pane_histogram(jnp.asarray(key), jnp.asarray(pane),
+                                   jnp.asarray(valid), K, P)
+        np.testing.assert_array_equal(np.asarray(got), oracle)
+    # '1' still engages the bypass (wrong on this input — that is its contract)
+    monkeypatch.setenv("WF_HISTOGRAM_FORCE_FAST", "1")
+    forced = keyed_pane_histogram(jnp.asarray(key), jnp.asarray(pane),
+                                  jnp.asarray(valid), K, P)
+    assert not np.array_equal(np.asarray(forced), oracle)

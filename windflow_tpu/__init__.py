@@ -26,6 +26,9 @@ from .operators.win_patterns import (Win_Farm, Key_Farm, Key_FFAT, Pane_Farm,
                                      Win_MapReduce, Nested_Farm)
 from .runtime import CompiledChain, Pipeline, Stats_Record
 from .stats import xprof_trace
+from .observability import (MetricsRegistry, MonitoringConfig, Reporter,
+                            EventJournal, LogHistogram, read_journal,
+                            topology_dot, topology_json)
 from .runtime.async_sink import AsyncResultShipper, ShippedResult
 from .runtime.checkpoint import save_chain, load_chain
 from .operators.source import prefetch_to_device

@@ -1,0 +1,146 @@
+"""Unified telemetry for windflow_tpu — the reference's MONITORING mode, grown up.
+
+Upstream WindFlow's ``MONITORING`` build aggregates every replica's
+``Stats_Record`` into a per-second graph-level JSON dump plus a graphviz
+diagram of the PipeGraph (SURVEY §5). This package is that layer for the TPU
+port, wired through the runtime:
+
+- :class:`MetricsRegistry` (``metrics.py``): graph-level aggregation of all
+  ``Stats_Record``s + log-bucket latency histograms (p50/p95/p99 batch service
+  time, end-to-end source→sink latency), watermark-lag gauges for TB windows,
+  SPSC queue-depth gauges under the threaded driver.
+- :class:`Reporter` (``reporter.py``): periodic daemon thread emitting JSON
+  snapshots and Prometheus text exposition to files.
+- :class:`EventJournal` (``journal.py``): JSONL spans for checkpoint/restore/
+  restart, ordering-buffer flushes, EOS propagation, sampled program launches.
+- ``topology.py``: dot + JSON export of the compiled graph, annotated with
+  live per-edge rates and queue depths.
+
+Everything is **off by default** (zero hot-path cost beyond a None check) and
+enabled per graph/pipeline via ``PipeGraph(..., monitoring=...)`` /
+``Pipeline(..., monitoring=...)`` or process-wide via ``WF_MONITORING``:
+
+    WF_MONITORING=1              # defaults: ./wf_monitoring, 1 s interval
+    WF_MONITORING=/path/out      # same, custom output directory
+    WF_MONITORING_INTERVAL=0.25  # reporter interval override (seconds)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Union
+
+from .journal import EventJournal, read_journal, set_active as set_journal
+from .metrics import LogHistogram, MetricsRegistry
+from .reporter import Reporter
+from .topology import (graph_topology_dot, graph_topology_json,
+                       pipeline_topology_dot, pipeline_topology_json,
+                       topology_dot, topology_json)
+from . import journal
+
+__all__ = [
+    "LogHistogram", "MetricsRegistry", "Reporter", "EventJournal",
+    "MonitoringConfig", "Monitor", "journal", "read_journal", "set_journal",
+    "topology_dot", "topology_json", "graph_topology_dot",
+    "graph_topology_json", "pipeline_topology_dot", "pipeline_topology_json",
+]
+
+
+@dataclasses.dataclass
+class MonitoringConfig:
+    """Resolved monitoring settings for one graph/pipeline run."""
+
+    out_dir: str = "wf_monitoring"
+    interval_s: float = 1.0
+    prometheus: bool = True
+    journal: bool = True
+    #: sample every Nth source batch for the end-to-end latency histogram
+    #: (a sample is two perf_counter reads around a sink receipt that is
+    #: host-synchronous anyway — cheap, so the default is dense)
+    e2e_sample_every: int = 4
+
+    def should_sample_e2e(self, n: int) -> bool:
+        """THE e2e sampling policy, shared by every driver: every Nth source
+        batch, never batch #1 — that one times JIT trace + XLA compile, not
+        latency (the same exclusion as the chain's service sampling)."""
+        return n > 0 and n % self.e2e_sample_every == 0
+
+    @classmethod
+    def resolve(cls, monitoring: Union[None, bool, str, "MonitoringConfig"],
+                ) -> Optional["MonitoringConfig"]:
+        """Normalize the user-facing ``monitoring=`` argument.
+
+        ``None`` consults ``WF_MONITORING`` (``''``/``'0'`` = off, the same
+        convention as ``WF_ORDERING_SKIP_SORTED``); ``False`` forces off;
+        ``True`` = defaults; a string is the output directory; a config passes
+        through. Returns None when monitoring is off."""
+        if monitoring is False:
+            return None
+        if isinstance(monitoring, MonitoringConfig):
+            cfg = monitoring
+        elif isinstance(monitoring, str):
+            cfg = cls(out_dir=monitoring)
+        elif monitoring is True:
+            cfg = cls()
+        else:                              # None: env-driven
+            env = os.environ.get("WF_MONITORING", "")
+            if env in ("", "0"):
+                return None
+            cfg = cls() if env == "1" else cls(out_dir=env)
+        iv = os.environ.get("WF_MONITORING_INTERVAL")
+        if iv:
+            cfg = dataclasses.replace(cfg, interval_s=float(iv))
+        return cfg
+
+
+class Monitor:
+    """Bundles registry + reporter + journal for one run and owns their
+    lifecycle: ``start()`` launches the reporter thread and activates the
+    journal; ``finish(target)`` stops the reporter (final snapshot), writes the
+    topology dumps (``topology.dot`` / ``topology.json``), and closes the
+    journal. ``finish`` is idempotent and runs in a ``finally`` inside the
+    drivers, so no thread survives a failed run."""
+
+    def __init__(self, config: MonitoringConfig, name: str = "pipegraph"):
+        self.config = config
+        os.makedirs(config.out_dir, exist_ok=True)
+        self.registry = MetricsRegistry(name)
+        self.journal: Optional[EventJournal] = None
+        if config.journal:
+            self.journal = EventJournal(
+                os.path.join(config.out_dir, "events.jsonl"))
+        self.reporter = Reporter(self.registry, config.out_dir,
+                                 interval_s=config.interval_s,
+                                 prometheus=config.prometheus)
+        self._finished = False
+
+    def start(self) -> None:
+        if self.journal is not None:
+            set_journal(self.journal)
+            self.journal.event("monitoring_start", graph=self.registry.name,
+                               interval_s=self.config.interval_s)
+        self.reporter.start()
+
+    def finish(self, target=None) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        try:
+            self.reporter.stop(final=True)
+            if target is not None:
+                snap = self.registry.snapshot()
+                with open(os.path.join(self.config.out_dir,
+                                       "topology.dot"), "w") as f:
+                    f.write(topology_dot(target, snap))
+                import json as _json
+                with open(os.path.join(self.config.out_dir,
+                                       "topology.json"), "w") as f:
+                    _json.dump(topology_json(target, snap), f, indent=1)
+        finally:
+            if self.journal is not None:
+                self.journal.event("monitoring_end",
+                                   graph=self.registry.name)
+                if journal.get_active() is self.journal:
+                    set_journal(None)
+                self.journal.close()

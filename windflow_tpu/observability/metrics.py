@@ -1,0 +1,393 @@
+"""Metrics primitives: log-bucket latency histograms + the graph-level registry.
+
+The reference's ``MONITORING`` mode runs a per-second reporter that folds every
+replica's ``Stats_Record`` into one graph-level JSON dump (SURVEY §5). This module
+is that aggregation layer for the TPU port: :class:`MetricsRegistry` walks a live
+``PipeGraph`` / ``Pipeline`` / ``CompiledChain``, sums replica counters, derives
+live rates from successive snapshots, extracts watermark-lag gauges from TB window
+states, and renders both a JSON snapshot and a Prometheus text exposition.
+
+Latency distributions use :class:`LogHistogram` — fixed log-spaced buckets
+(growth ``sqrt(2)``: every bucket's upper bound is ~41% above its lower bound, so
+a reported percentile is within that factor of the true sample percentile).
+Recording is O(log n_buckets) on the host (one ``bisect``), cheap enough to stay
+always-on for the sampled service times (one sample per
+``CompiledChain.SERVICE_SAMPLE_EVERY`` pushes).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: histogram geometry: bounds[i] = BASE_S * GROWTH**i, spanning 1 us .. ~90 s
+_BASE_S = 1e-6
+_GROWTH = 2.0 ** 0.5
+_N_BUCKETS = 54
+
+
+class LogHistogram:
+    """Log-spaced latency histogram (seconds). Thread-safe for concurrent
+    ``record`` (reporter thread reads while driver threads write)."""
+
+    #: shared, immutable upper bounds (seconds); the last bucket is +inf
+    BOUNDS: List[float] = [_BASE_S * _GROWTH ** i for i in range(_N_BUCKETS)]
+
+    def __init__(self):
+        self.counts = [0] * (_N_BUCKETS + 1)      # +1 = overflow bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        s = float(seconds)
+        if s < 0.0:
+            s = 0.0
+        i = bisect.bisect_left(self.BOUNDS, s)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += s
+            if s < self.min:
+                self.min = s
+            if s > self.max:
+                self.max = s
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100]): the upper bound of the
+        bucket holding the q-th sample — an overestimate by at most one bucket
+        width (factor sqrt(2))."""
+        if not self.count:
+            return 0.0
+        target = max(1, int(q / 100.0 * self.count + 0.5))
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                if i >= _N_BUCKETS:              # overflow bucket
+                    return self.max
+                return min(self.BOUNDS[i], self.max)
+        return self.max
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def summary_us(self) -> Dict[str, float]:
+        """p50/p95/p99 + mean in microseconds (the snapshot's unit)."""
+        return {
+            "p50": round(self.percentile(50) * 1e6, 3),
+            "p95": round(self.percentile(95) * 1e6, 3),
+            "p99": round(self.percentile(99) * 1e6, 3),
+            "mean": round(self.mean * 1e6, 3),
+            "max": round(self.max * 1e6, 3) if self.count else 0.0,
+            "samples": self.count,
+        }
+
+    def prometheus_buckets(self):
+        """Cumulative (le_seconds, count) pairs, Prometheus histogram form."""
+        out, acc = [], 0
+        for i, c in enumerate(self.counts[:_N_BUCKETS]):
+            acc += c
+            out.append((self.BOUNDS[i], acc))
+        out.append((float("inf"), self.count))
+        return out
+
+
+#: counter fields summed across replicas and exposed per operator
+_COUNTERS = ("inputs_received", "outputs_sent", "bytes_received", "bytes_sent",
+             "batches_received", "batches_sent", "num_kernels",
+             "bytes_copied_hd", "bytes_copied_dh", "tuples_dropped_old")
+
+
+class MetricsRegistry:
+    """Aggregates every ``Stats_Record`` of a running graph into one snapshot.
+
+    Sources of truth are registered once and walked live at snapshot time (so
+    lazily-compiled chains and late-built Ordering_Nodes are picked up):
+
+    - ``register_graph(graph)``: a PipeGraph — walks ``_all_pipes()`` for
+      sources, chains (ops + states), sinks, Ordering_Nodes, and (threaded
+      driver) SPSC edge queues.
+    - ``register_pipeline(pipeline)``: a linear Pipeline (source/chain/sink).
+    - ``register_chain(label, chain)`` / ``register_operator(op)``: raw pieces
+      (bench harnesses).
+
+    ``snapshot()`` additionally derives per-operator input/output rates from
+    the delta against the previous snapshot and pulls watermark-lag gauges out
+    of TB window states (a tiny D2H read — monitoring-path only).
+    """
+
+    def __init__(self, name: str = "pipegraph"):
+        self.name = name
+        self.created = time.monotonic()
+        self.e2e_hist = LogHistogram()       # source framing -> sink host receipt
+        self._graphs: List[Any] = []
+        self._pipelines: List[Any] = []
+        self._chains: List[tuple] = []       # (label, CompiledChain)
+        self._operators: List[Any] = []
+        self._gauges: Dict[str, Callable[[], Any]] = {}
+        self._queue_gauges: Dict[str, Callable[[], int]] = {}
+        self._prev: Dict[int, tuple] = {}    # id(op) -> (t, inputs, outputs)
+        self._lock = threading.Lock()
+
+    # -- registration -----------------------------------------------------------------
+
+    def register_graph(self, graph) -> None:
+        self._graphs.append(graph)
+
+    def register_pipeline(self, pipeline) -> None:
+        self._pipelines.append(pipeline)
+
+    def register_chain(self, label: str, chain) -> None:
+        self._chains.append((label, chain))
+
+    def register_operator(self, op) -> None:
+        self._operators.append(op)
+
+    def attach_gauge(self, name: str, fn: Callable[[], Any]) -> None:
+        self._gauges[name] = fn
+
+    def attach_queue_gauge(self, edge: str, fn: Callable[[], int]) -> None:
+        """SPSC ring depth probe for one dataflow edge (threaded driver):
+        depth/capacity is the backpressure signal — a persistently full ring
+        means the consumer pipe is the bottleneck."""
+        self._queue_gauges[edge] = fn
+
+    def record_e2e(self, seconds: float) -> None:
+        self.e2e_hist.record(seconds)
+
+    # -- collection -------------------------------------------------------------------
+
+    def _op_units(self):
+        """Yield (op, state_or_None) for every operator currently visible."""
+        seen = set()
+
+        def emit(op, state=None):
+            if op is None or id(op) in seen:
+                return
+            seen.add(id(op))
+            yield op, state
+
+        for g in self._graphs:
+            for mp in g._all_pipes():
+                if mp.source is not None:
+                    yield from emit(mp.source)
+                ch = mp._chain
+                if ch is not None:
+                    for op, st in zip(ch.ops, ch.states):
+                        yield from emit(op, st)
+                else:
+                    for op in mp.ops:
+                        yield from emit(op)
+                if mp.sink is not None:
+                    yield from emit(mp.sink)
+        for p in self._pipelines:
+            yield from emit(p.source)
+            for op, st in zip(p.chain.ops, p.chain.states):
+                yield from emit(op, st)
+            if p.sink is not None:
+                yield from emit(p.sink)
+        for _, ch in self._chains:
+            for op, st in zip(ch.ops, ch.states):
+                yield from emit(op, st)
+        for op in self._operators:
+            yield from emit(op)
+
+    @staticmethod
+    def _watermark_gauge(op, state) -> Optional[dict]:
+        """TB window frontier gauge from a window operator's carried state:
+        ``wm`` (max event ts seen) vs the firing frontier ``next_win * slide``.
+        ``lag`` is the span of arrived-but-unfired event time — the
+        watermark-lag of the stage."""
+        import numpy as np
+        spec = getattr(op, "spec", None)
+        if (spec is None or getattr(spec, "is_cb", True)
+                or state is None
+                or not hasattr(state, "wm") or not hasattr(state, "next_win")):
+            return None
+        try:
+            wm = int(np.max(np.asarray(state.wm)))
+            nxt = int(np.max(np.asarray(state.next_win)))
+        except Exception:       # noqa: BLE001 — donated/abstract state mid-run
+            return None
+        frontier = nxt * spec.slide
+        return {"watermark_ts": wm, "fire_frontier_ts": frontier,
+                "lag_ts": max(wm - frontier + 1, 0) if wm >= 0 else 0}
+
+    def snapshot(self) -> dict:
+        """One graph-level snapshot: per-operator aggregated counters + rates +
+        latency percentiles, watermark gauges, queue depths, e2e latency."""
+        now = time.monotonic()
+        ops_out = []
+        totals = {k: 0 for k in _COUNTERS}
+        with self._lock:
+            for op, state in self._op_units():
+                # sync device-resident counters (e.g. Win_SeqFFAT.dropped_old)
+                # into the host Stats_Record before reading it
+                try:
+                    op.collect_stats(state)
+                except Exception:   # noqa: BLE001 — never kill a snapshot
+                    pass
+                recs = op.get_StatsRecords()
+                row = {"name": op.getName(),
+                       "replicas": len(recs),
+                       "routing": op.getRoutingMode().name}
+                for k in _COUNTERS:
+                    v = sum(getattr(r, k, 0) for r in recs)
+                    row[k] = v
+                    totals[k] += v
+                # service-time distribution: merged across replicas
+                merged = LogHistogram()
+                for r in recs:
+                    h = getattr(r, "service_hist", None)
+                    if h is not None and h.count:
+                        for i, c in enumerate(h.counts):
+                            merged.counts[i] += c
+                        merged.count += h.count
+                        merged.sum += h.sum
+                        merged.max = max(merged.max, h.max)
+                        merged.min = min(merged.min, h.min)
+                row["service_time_us"] = merged.summary_us()
+                # rates vs the previous snapshot. Mid-chain operators count
+                # batches/bytes, not tuples (per-tuple counts would need a
+                # device sync per push), so batch + byte rates are the
+                # universally-populated signals; tuple rates are live at the
+                # host boundaries (sources count launches, sinks tuples).
+                prev = self._prev.get(id(op))
+                if prev is not None and now > prev[0]:
+                    dt = now - prev[0]
+                    row["rate_in_tps"] = round(
+                        (row["inputs_received"] - prev[1]) / dt, 1)
+                    row["rate_out_tps"] = round(
+                        (row["outputs_sent"] - prev[2]) / dt, 1)
+                    row["rate_batches_in_per_s"] = round(
+                        (row["batches_received"] - prev[3]) / dt, 2)
+                    row["rate_bytes_in_per_s"] = round(
+                        (row["bytes_received"] - prev[4]) / dt, 1)
+                else:
+                    up = max(now - self.created, 1e-9)
+                    row["rate_in_tps"] = round(row["inputs_received"] / up, 1)
+                    row["rate_out_tps"] = round(row["outputs_sent"] / up, 1)
+                    row["rate_batches_in_per_s"] = round(
+                        row["batches_received"] / up, 2)
+                    row["rate_bytes_in_per_s"] = round(
+                        row["bytes_received"] / up, 1)
+                self._prev[id(op)] = (now, row["inputs_received"],
+                                      row["outputs_sent"],
+                                      row["batches_received"],
+                                      row["bytes_received"])
+                wmg = self._watermark_gauge(op, state)
+                if wmg is not None:
+                    row["watermark"] = wmg
+                ops_out.append(row)
+        queues = {}
+        for edge, fn in list(self._queue_gauges.items()):
+            try:
+                queues[edge] = int(fn())
+            except Exception:       # noqa: BLE001 — queue freed after EOS
+                queues[edge] = 0
+        gauges = {}
+        for gname, fn in list(self._gauges.items()):
+            try:
+                gauges[gname] = fn()
+            except Exception:       # noqa: BLE001
+                pass
+        orderings = []
+        for g in self._graphs:
+            for i, mp in enumerate(g._all_pipes()):
+                o = mp._ordering
+                if o is not None:
+                    orderings.append({
+                        "pipe": i,
+                        "pending_capacity": (0 if o._pending is None
+                                             else int(o._pending.capacity)),
+                        "last_release_count": int(o.last_release_count),
+                        "mode": o.mode.name,
+                    })
+        snap = {
+            "graph": self.name,
+            "wall_time": time.time(),
+            "uptime_s": round(now - self.created, 3),
+            "operators": ops_out,
+            "totals": totals,
+            "e2e_latency_us": self.e2e_hist.summary_us(),
+            "queues": queues,
+            "ordering": orderings,
+        }
+        if gauges:
+            snap["gauges"] = gauges
+        return snap
+
+    # -- Prometheus text exposition ----------------------------------------------------
+
+    def to_prometheus(self, snap: Optional[dict] = None) -> str:
+        """Render the snapshot in the Prometheus text format (one scrape body).
+        Metric names: ``windflow_<counter>_total`` per-operator counters,
+        ``windflow_service_time_seconds`` / ``windflow_e2e_latency_seconds``
+        histograms, ``windflow_queue_depth`` / ``windflow_watermark_lag``
+        gauges."""
+        snap = snap or self.snapshot()
+        g = snap["graph"]
+        lines = []
+
+        def esc(s):
+            return str(s).replace("\\", "\\\\").replace('"', '\\"')
+
+        for c in _COUNTERS:
+            lines.append(f"# TYPE windflow_{c}_total counter")
+            for row in snap["operators"]:
+                lines.append(
+                    f'windflow_{c}_total{{graph="{esc(g)}",'
+                    f'operator="{esc(row["name"])}"}} {row[c]}')
+        lines.append("# TYPE windflow_rate_in_tps gauge")
+        for row in snap["operators"]:
+            lines.append(f'windflow_rate_in_tps{{graph="{esc(g)}",'
+                         f'operator="{esc(row["name"])}"}} {row["rate_in_tps"]}')
+        lines.append("# TYPE windflow_watermark_lag gauge")
+        for row in snap["operators"]:
+            if "watermark" in row:
+                lines.append(
+                    f'windflow_watermark_lag{{graph="{esc(g)}",'
+                    f'operator="{esc(row["name"])}"}} '
+                    f'{row["watermark"]["lag_ts"]}')
+        lines.append("# TYPE windflow_queue_depth gauge")
+        for edge, depth in snap["queues"].items():
+            lines.append(f'windflow_queue_depth{{graph="{esc(g)}",'
+                         f'edge="{esc(edge)}"}} {depth}')
+        # service-time histograms, straight from the live LogHistograms
+        lines.append("# TYPE windflow_service_time_seconds histogram")
+        with self._lock:
+            for op, _state in self._op_units():
+                for r in op.get_StatsRecords():
+                    h = getattr(r, "service_hist", None)
+                    if h is None or not h.count:
+                        continue
+                    lab = (f'graph="{esc(g)}",operator="{esc(op.getName())}",'
+                           f'replica="{r.replica_id}"')
+                    for le, acc in h.prometheus_buckets():
+                        le_s = "+Inf" if le == float("inf") else f"{le:.9g}"
+                        lines.append(
+                            f'windflow_service_time_seconds_bucket'
+                            f'{{{lab},le="{le_s}"}} {acc}')
+                    lines.append(
+                        f'windflow_service_time_seconds_sum{{{lab}}} {h.sum:.9g}')
+                    lines.append(
+                        f'windflow_service_time_seconds_count{{{lab}}} {h.count}')
+        h = self.e2e_hist
+        if h.count:
+            lines.append("# TYPE windflow_e2e_latency_seconds histogram")
+            lab = f'graph="{esc(g)}"'
+            for le, acc in h.prometheus_buckets():
+                le_s = "+Inf" if le == float("inf") else f"{le:.9g}"
+                lines.append(f'windflow_e2e_latency_seconds_bucket'
+                             f'{{{lab},le="{le_s}"}} {acc}')
+            lines.append(f'windflow_e2e_latency_seconds_sum{{{lab}}} {h.sum:.9g}')
+            lines.append(f'windflow_e2e_latency_seconds_count{{{lab}}} {h.count}')
+        lines.append(f'windflow_uptime_seconds{{graph="{esc(g)}"}} '
+                     f'{snap["uptime_s"]}')
+        return "\n".join(lines) + "\n"

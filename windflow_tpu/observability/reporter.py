@@ -1,0 +1,88 @@
+"""Periodic reporter thread — the reference's MONITORING-mode per-second dump.
+
+Upstream WindFlow's ``MONITORING`` build runs a reporter that aggregates every
+replica's ``Stats_Record`` into a JSON dump once per second (SURVEY §5). Here a
+single daemon thread snapshots the :class:`~.metrics.MetricsRegistry` every
+``interval_s`` and writes:
+
+- ``snapshot.json``   — the latest graph-level snapshot (atomic replace);
+- ``snapshots.jsonl`` — one line per tick (time series for later analysis);
+- ``metrics.prom``    — Prometheus text exposition (point a file-based scraper
+  or ``node_exporter`` textfile collector at it).
+
+Off by default; started/stopped by the Monitor. ``stop()`` joins the thread and
+emits one final snapshot, so no thread outlives the run (tested)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from .metrics import MetricsRegistry
+
+
+def _atomic_write(path: str, data: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class Reporter:
+    def __init__(self, registry: MetricsRegistry, out_dir: str,
+                 interval_s: float = 1.0, prometheus: bool = True):
+        self.registry = registry
+        self.out_dir = out_dir
+        self.interval_s = max(0.05, float(interval_s))
+        self.prometheus = prometheus
+        self.ticks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(out_dir, exist_ok=True)
+
+    # -- lifecycle --------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, name="wf-reporter",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, final: bool = True) -> None:
+        """Signal, join, and (by default) write one last snapshot so short
+        runs that never crossed an interval still leave artifacts."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None
+        if final:
+            self.emit()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- emission ---------------------------------------------------------------------
+
+    def emit(self) -> dict:
+        snap = self.registry.snapshot()
+        _atomic_write(os.path.join(self.out_dir, "snapshot.json"),
+                      json.dumps(snap, indent=1, sort_keys=True))
+        with open(os.path.join(self.out_dir, "snapshots.jsonl"), "a") as f:
+            f.write(json.dumps(snap) + "\n")
+        if self.prometheus:
+            _atomic_write(os.path.join(self.out_dir, "metrics.prom"),
+                          self.registry.to_prometheus(snap))
+        self.ticks += 1
+        return snap
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.emit()
+            except Exception:       # noqa: BLE001 — a bad tick must not kill
+                pass                # the reporter (snapshot retries next tick)

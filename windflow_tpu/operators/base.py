@@ -79,6 +79,12 @@ class Basic_Operator:
     def get_StatsRecords(self):
         return list(self._stats)
 
+    def collect_stats(self, state: Any = None) -> None:
+        """Sync device-resident counters carried in ``state`` into the host
+        ``Stats_Record`` (e.g. Win_SeqFFAT's OLD-drop counter). Called by the
+        metrics registry at snapshot time and by the drivers at EOS — a tiny
+        D2H read off the hot path; no-op by default."""
+
     # pythonic aliases
     name = property(getName)
     parallelism = property(getParallelism)

@@ -47,6 +47,7 @@ class FFATState:
     count: jax.Array      # i32[K] tuples seen per key (CB position source)
     wm: jax.Array         # i32[K] per-key max ts
     next_win: jax.Array   # i32[K]
+    dropped_old: jax.Array  # i32[] tuples dropped as OLD (TB straggler drops)
 
 
 @jax.tree_util.register_dataclass
@@ -60,6 +61,7 @@ class GFFATState:
     cnt: jax.Array        # i32[K, P] tuples per pane slot (emptiness filter)
     wm: jax.Array         # i32[] global max ts seen
     next_win: jax.Array   # i32[] next window id to fire (global)
+    dropped_old: jax.Array  # i32[] tuples dropped as OLD (pane < fired horizon)
 
 
 class Win_SeqFFAT(Basic_Operator):
@@ -78,8 +80,13 @@ class Win_SeqFFAT(Basic_Operator):
         # the hot path (take() costs ~5.6 ns/elem on TPU; scatter-add ~7 — the insert
         # becomes two scatters total). Default on for TB: streaming benchmarks and
         # real event streams share one clock (the reference's TB windows likewise
-        # advance on tuple timestamps, wf/window.hpp:83-121; per-key skew only delays
-        # firing, it does not change window contents).
+        # advance on tuple timestamps, wf/window.hpp:83-121). CAVEAT: the frontier
+        # advances on the GLOBAL watermark, so a key whose tuples lag more than
+        # `delay` behind the fastest key's clock has its stragglers dropped as OLD
+        # once their panes fall behind the fired horizon — per-key skew > delay DOES
+        # change window contents (the per-key-watermark path only delays firing).
+        # Drops are counted on device (state.dropped_old) and surfaced through
+        # Stats_Record.tuples_dropped_old / the monitoring graph snapshot.
         self.global_time = (not spec.is_cb) if global_time is None else global_time
         if self.global_time and spec.is_cb:
             raise ValueError("global_time applies to TB windows only")
@@ -143,6 +150,7 @@ class Win_SeqFFAT(Basic_Operator):
                 cnt=jnp.zeros((K, P), CTRL_DTYPE),
                 wm=jnp.asarray(-1, CTRL_DTYPE),
                 next_win=jnp.asarray(0, CTRL_DTYPE),
+                dropped_old=jnp.zeros((), CTRL_DTYPE),
             )
         return FFATState(
             panes=jax.tree.map(
@@ -154,6 +162,7 @@ class Win_SeqFFAT(Basic_Operator):
             count=jnp.zeros((K,), CTRL_DTYPE),
             wm=jnp.full((K,), -1, CTRL_DTYPE),
             next_win=jnp.zeros((K,), CTRL_DTYPE),
+            dropped_old=jnp.zeros((), CTRL_DTYPE),
         )
 
     def out_spec(self, payload_spec: Any) -> Any:
@@ -174,6 +183,9 @@ class Win_SeqFFAT(Basic_Operator):
         pane = batch.ts // self.pane_len
         horizon = state.next_win * self.spanes       # first un-fired pane (global)
         valid = batch.valid & (pane >= horizon)
+        # stragglers behind the fired horizon are DROPPED, not merely delayed
+        # (global clock: per-key skew > delay loses tuples) — count them
+        n_dropped = jnp.sum((batch.valid & ~valid).astype(CTRL_DTYPE))
         cnt_upd = keyed_pane_histogram(batch.key, pane, valid, K, P)
         cnt = state.cnt + cnt_upd
         if self.count_lift is None:
@@ -203,6 +215,7 @@ class Win_SeqFFAT(Basic_Operator):
             panes=panes,
             cnt=cnt,
             wm=jnp.maximum(state.wm, jnp.max(jnp.where(batch.valid, batch.ts, -1))),
+            dropped_old=state.dropped_old + n_dropped,
         )
 
     def _g_emit(self, state: GFFATState, W_n: int, flush: bool):
@@ -293,9 +306,12 @@ class Win_SeqFFAT(Basic_Operator):
             rank = segment_rank(batch.key, valid)
             pos = table_lookup(state.count, batch.key) + rank
             pane = pos // self.pane_len
+            n_dropped = jnp.zeros((), CTRL_DTYPE)    # CB never drops OLD tuples
         else:
             horizon = table_lookup(state.next_win, batch.key) * self.spec.slide
-            valid = valid & (batch.ts >= horizon)
+            kept = valid & (batch.ts >= horizon)
+            n_dropped = jnp.sum((valid & ~kept).astype(CTRL_DTYPE))
+            valid = kept
             pane = batch.ts // self.pane_len
         slot = pane % P
         seg = jnp.where(valid, batch.key * P + slot, K * P)
@@ -333,6 +349,7 @@ class Win_SeqFFAT(Basic_Operator):
             pane_of=new_pane_of,
             count=state.count + counts_add,
             wm=jnp.maximum(state.wm, ts_max),
+            dropped_old=state.dropped_old + n_dropped,
         )
 
     # ------------------------------------------------------------------ fire
@@ -416,9 +433,18 @@ class Win_SeqFFAT(Basic_Operator):
             emit = self._g_emit if self.global_time else self._emit
             self._flush_jit = jax.jit(lambda st: emit(st, W, flush=True))
         state, out = self._flush_jit(state)
+        self.collect_stats(state)
         if not bool(jnp.any(out.valid)):
             return state, None
         return state, out
+
+    def collect_stats(self, state=None) -> None:
+        """Sync the device-resident OLD-drop counter into the Stats_Record
+        (monitoring snapshot / EOS — one scalar D2H read, off the hot path)."""
+        if state is None or not hasattr(state, "dropped_old"):
+            return
+        import numpy as np
+        self._stats[0].tuples_dropped_old = int(np.asarray(state.dropped_old))
 
 
 def _detect_count_lift(lift, batch) -> bool:

@@ -107,9 +107,24 @@ def keyed_pane_histogram(key: jax.Array, pane: jax.Array, valid: jax.Array,
                                   preferred_element_type=jnp.float32)
         return out.astype(jnp.int32)
 
+    # NOTE: both env vars are read at TRACE time — a jitted executable compiled
+    # before the env change keeps the old impl for the life of the process
+    # (XLA caches the traced program, not the env). For A/B runs or tests that
+    # toggle WF_HISTOGRAM_IMPL via monkeypatch, force a retrace (fresh jit /
+    # different shapes) or pass impl= explicitly. Same caveat as WF_LOOKUP_IMPL
+    # (ops/lookup.py).
     impl = impl or os.environ.get("WF_HISTOGRAM_IMPL", "xla")
-    force_fast = bool(os.environ.get("WF_HISTOGRAM_FORCE_FAST"))
+    # '0'/empty = off — the WF_ORDERING_SKIP_SORTED convention (a bare bool()
+    # of the string made '0' ENABLE the wrong-answer diagnostic bypass)
+    force_fast = os.environ.get("WF_HISTOGRAM_FORCE_FAST", "0") not in ("", "0")
     if impl.startswith("pallas"):
+        if P < locality:
+            # the Pallas kernel's single-fold wrap (padded[:, :P] += padded[:,
+            # P:]) assumes locality <= ring; for P < L the [K,P] target vs
+            # [K,L] addend shapes mismatch — route to the exact scatter path
+            # (the XLA fast branch handles any P via % P, but keeping both
+            # guards identical keeps the impls interchangeable)
+            return _scatter_hist(key, pane, valid, K, P)
         # "pallas": dynamic-slice store of the [K, L] chunk histogram into the
         # ring (8-wide store at a traced lane offset — Mosaic may refuse the
         # minor-dim dynamic slice on some generations). "pallas_mm": placement
@@ -163,7 +178,10 @@ def keyed_pane_histogram_pallas(key: jax.Array, pane: jax.Array,
     auto-enabled on the CPU backend)."""
     C = key.shape[0]
     K, P = int(num_keys), int(ring)
-    if C % chunk != 0 or C < chunk:
+    if C % chunk != 0 or C < chunk or P < locality:
+        # P < locality: the kernel's single-fold wrap-around (one [K, L] spill
+        # block folded onto the ring head) is shape-mismatched and arithmetically
+        # wrong when the spill spans the ring more than once — exact scatter
         return _scatter_hist(key, pane, valid, K, P)
     return _pallas_fast(key, pane, valid, K, P, chunk, locality,
                         placement=placement, interpret=interpret)

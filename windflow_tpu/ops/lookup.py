@@ -53,6 +53,12 @@ def table_lookup(table: jax.Array, idx: jax.Array, *,
     ``table``: ``[K, ...]``; ``idx``: ``[C]`` int32 in [0, K). Out-of-range indices
     return 0 in the select/factored paths; clamp beforehand if needed."""
     K = table.shape[0]
+    # NOTE: WF_LOOKUP_IMPL is read at TRACE time — a cached jitted executable
+    # built before an env change keeps the old impl within the process (an A/B
+    # or a monkeypatch.setenv against a shared jitted step would silently
+    # measure the same implementation twice). Force a retrace or pass impl=
+    # explicitly for anything long-lived. Same caveat as WF_HISTOGRAM_IMPL
+    # (ops/histogram.py).
     impl = impl or os.environ.get("WF_LOOKUP_IMPL", "xla")
 
     def factored(t, i):

@@ -405,6 +405,15 @@ class Ordering_Node:
         self.last_release_count = n_out
         return out
 
+    def _journal_release(self, event: str, **fields) -> None:
+        """Emit an ordering-buffer event to the active journal (EOS-granular —
+        close_channel / flush, never the per-push hot path)."""
+        from ..observability import journal as _journal
+        if _journal.get_active() is not None:
+            _journal.record(event, mode=self.mode.name,
+                            n_inputs=self.n_inputs,
+                            released=self.last_release_count, **fields)
+
     def close_channel(self, channel: int) -> Optional[Batch]:
         """Channel EOS: it no longer gates the low-watermark (a liveness
         extension over the reference, whose ``eosnotify`` only flushes once ALL
@@ -421,12 +430,15 @@ class Ordering_Node:
         soon as a dead channel can no longer reorder them — same final order,
         earlier liveness."""
         self._wm_dev = self._wm_dev.at[channel].set(jnp.iinfo(CTRL_DTYPE).max)
-        return self.try_release()
+        out = self.try_release()
+        self._journal_release("ordering_close_channel", channel=channel)
+        return out
 
     def flush(self) -> Optional[Batch]:
         """EOS: release everything, sorted (the pool already is)."""
         if self._pending is None:
             self.last_release_count = 0
+            self._journal_release("ordering_flush")
             return None
         out, _, _, counts, nid = self._release_jit(
             self._pending, self._pending_chan, self._wm_dev, self._next_id,
@@ -434,4 +446,5 @@ class Ordering_Node:
         self._pending, self._pending_chan = None, None
         self._next_id = nid
         self.last_release_count = int(np.asarray(counts)[0])
+        self._journal_release("ordering_flush")
         return out

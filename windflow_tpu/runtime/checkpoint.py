@@ -36,13 +36,36 @@ def save_chain(chain: CompiledChain, path: str, *, meta: dict = None) -> None:
 
 
 def load_chain(chain: CompiledChain, path: str) -> dict:
-    """Restore states in place; returns the saved metadata dict."""
+    """Restore states in place; returns the saved metadata dict.
+
+    Legacy compatibility: a checkpoint written before a state dataclass grew a
+    trailing field (e.g. Win_SeqFFAT's ``dropped_old`` counter) is short by
+    those leaves — registered dataclasses flatten in field order, so the
+    missing keys are exactly the tail. Absent leaves keep the chain's
+    freshly-initialized value (zeros for counters) instead of raising — the
+    same stance as the supervisor's legacy-``wm`` mapping."""
     data = np.load(path)
+    present = set(getattr(data, "files", []))
     new_states = []
     for i, st in enumerate(chain.states):
         leaves, treedef = jax.tree.flatten(st)
-        restored = [jax.numpy.asarray(data[f"op{i}_leaf{j}"])
-                    for j in range(len(leaves))]
+        have = [f"op{i}_leaf{j}" in present for j in range(len(leaves))]
+        # only a missing TRAILING suffix of a present state is the legacy
+        # grown-field case; a gap (missing leaf followed by a present one) or
+        # an op whose state is entirely absent means a mismatched or truncated
+        # checkpoint — keep the loud KeyError for those
+        n_present = sum(have)
+        if leaves and n_present == 0:
+            raise KeyError(
+                f"checkpoint {path!r} has no op{i}_leaf* keys for a stateful "
+                f"operator — mismatched chain or truncated file")
+        if have[n_present:] != [False] * (len(leaves) - n_present):
+            j_bad = have.index(False)
+            raise KeyError(
+                f"checkpoint {path!r} is missing op{i}_leaf{j_bad} but has "
+                f"later leaves of op{i} — mismatched chain or truncated file")
+        restored = [jax.numpy.asarray(data[f"op{i}_leaf{j}"]) if have[j]
+                    else leaves[j] for j in range(len(leaves))]
         new_states.append(jax.tree.unflatten(treedef, restored))
     chain.states = new_states
     raw = data.get("__meta__")

@@ -253,13 +253,21 @@ class PipeGraph:
     """The streaming environment (``wf/pipegraph.hpp:104-244``)."""
 
     def __init__(self, name: str = "pipegraph", mode: Mode = Mode.DEFAULT,
-                 batch_size: int = None):
+                 batch_size: int = None, monitoring=None):
         self.name = name
         self.mode = mode
         #: None = resolve at start(): min withBatch hint over registered
         #: operators (capacity ceilings, wf/builders_gpu.hpp:115-122), else
         #: DEFAULT_BATCH_SIZE; an explicit value always wins.
         self.batch_size = batch_size
+        #: telemetry opt-in (the reference's MONITORING mode): None = consult
+        #: WF_MONITORING; True / out-dir string / observability.MonitoringConfig
+        #: enable the metrics registry + periodic reporter + event journal +
+        #: topology dump for this graph's run. Off by default (zero hot-path
+        #: cost beyond a None check).
+        self._monitoring_arg = monitoring
+        self._monitor = None
+        self._e2e_t0 = None           # in-flight e2e latency sample start
         self._roots: List[MultiPipe] = []
         self._merged_roots: List[MultiPipe] = []
         self._nodes = {}
@@ -297,6 +305,13 @@ class PipeGraph:
             self.batch_size = (resolve_batch_hint(self._operators)
                                or DEFAULT_BATCH_SIZE)
         self._started = True
+        if self._monitor is None:
+            from ..observability import Monitor, MonitoringConfig
+            cfg = MonitoringConfig.resolve(self._monitoring_arg)
+            if cfg is not None:
+                self._monitor = Monitor(cfg, self.name)
+                self._monitor.registry.register_graph(self)
+                self._monitor.start()
 
     def run_supervised(self, *, checkpoint_every: int = 8,
                        max_restarts: int = 3):
@@ -315,6 +330,7 @@ class PipeGraph:
         from ..native import SPSCQueue
 
         pipes = self._all_pipes()
+        pipe_idx = {id(p): i for i, p in enumerate(pipes)}
         EOS = object()
         # one SPSC ring per dataflow EDGE (single producer, single consumer); a
         # consumer with several inputs (merge) polls its rings round-robin
@@ -326,6 +342,12 @@ class PipeGraph:
             q = SPSCQueue(8)
             in_queues[id(dst)].append(q)
             out_edges[(src_id, id(dst))] = q
+            if self._monitor is not None:
+                # live ring-depth gauge per dataflow edge: depth near capacity
+                # = backpressure, the consumer pipe is the bottleneck
+                label = (f"src->{pipe_idx[id(dst)]}" if src_id == "src"
+                         else f"{pipe_idx[src_id]}->{pipe_idx[id(dst)]}")
+                self._monitor.registry.attach_queue_gauge(label, q.size)
             return q
 
         for p in pipes:
@@ -353,6 +375,9 @@ class PipeGraph:
                 out_edges[(id(mp), id(merged))].push(out)
 
         def propagate_eos(mp):
+            from ..observability import journal as _journal
+            _journal.record("eos_propagate", graph=self.name,
+                            pipe=pipe_idx[id(mp)])
             for branch in mp.split_branches:
                 out_edges[(id(mp), id(branch))].push(EOS)
             for merged in mp._outputs_to:
@@ -417,23 +442,30 @@ class PipeGraph:
             finally:
                 q.push(EOS)
 
-        threads = []
-        for p in pipes:
-            threads.append(threading.Thread(target=pipe_body, args=(p,),
-                                            name=f"wf-pipe-{id(p) % 1000}"))
-        for p in self._roots:
-            threads.append(threading.Thread(target=source_body, args=(p,),
-                                            name="wf-src"))
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
-        for op in self._operators:
-            op.close()                # closing_func per replica (svc_end parity)
-        self._ended = True
-        return self._results()
+        try:
+            threads = []
+            for p in pipes:
+                threads.append(threading.Thread(target=pipe_body, args=(p,),
+                                                name=f"wf-pipe-{id(p) % 1000}"))
+            for p in self._roots:
+                threads.append(threading.Thread(target=source_body, args=(p,),
+                                                name="wf-src"))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+            for p in pipes:
+                if p._chain is not None:
+                    p._chain.sync_stats()
+            for op in self._operators:
+                op.close()            # closing_func per replica (svc_end parity)
+            self._ended = True
+            return self._results()
+        finally:
+            if self._monitor is not None:
+                self._monitor.finish(self)
 
     def wait_end(self):
         """Drive the whole DAG to completion (the reference joins threads here,
@@ -442,36 +474,57 @@ class PipeGraph:
             return self._results()
         if not self._started:
             self.start()              # resolves batch_size from withBatch hints
+        import time as _time
         from .pipeline import record_source_launch
-        sources = [(mp, mp.source.batches(self.batch_size)) for mp in self._roots]
-        live = list(sources)
-        round_robin_pos = 0
-        while live:
-            mp, it = live[round_robin_pos % len(live)]
-            try:
-                batch = next(it)
-            except StopIteration:
-                live.remove((mp, it))
-                self._exhaust(mp)
-                continue
-            self._push(mp, batch)
-            round_robin_pos += 1
-            record_source_launch(mp.source, batch)
-        # EOS: flush every pipe in topological order; a merged pipe first drains
-        # its Ordering_Node (tuples held back by the low-watermark)
-        for mp in self._topo_order():
-            if mp._ordering is not None:
-                for piece in self._chunks(mp._ordering.flush(),
-                                          mp._ordering.last_release_count):
-                    self._push(mp, piece)
-            self._flush_pipe(mp)
-        for mp in self._all_pipes():
-            if mp.sink is not None:
-                mp.sink.consume(None)
-        for op in self._operators:
-            op.close()                # closing_func per replica (svc_end parity)
-        self._ended = True
-        return self._results()
+        from ..observability import journal as _journal
+        try:
+            sources = [(mp, mp.source.batches(self.batch_size))
+                       for mp in self._roots]
+            live = list(sources)
+            round_robin_pos = 0
+            n_pushed = 0
+            while live:
+                mp, it = live[round_robin_pos % len(live)]
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    live.remove((mp, it))
+                    self._exhaust(mp)
+                    continue
+                if (self._monitor is not None
+                        and self._monitor.config.should_sample_e2e(n_pushed)):
+                    # e2e latency sample: source framing -> first sink's host
+                    # receipt (recorded in _deliver after sink.consume)
+                    self._e2e_t0 = _time.perf_counter()
+                self._push(mp, batch)
+                self._e2e_t0 = None
+                n_pushed += 1
+                round_robin_pos += 1
+                record_source_launch(mp.source, batch)
+            # EOS: flush every pipe in topological order; a merged pipe first
+            # drains its Ordering_Node (tuples held back by the low-watermark)
+            pipe_idx = {id(p): i for i, p in enumerate(self._all_pipes())}
+            for mp in self._topo_order():
+                _journal.record("eos_flush", graph=self.name,
+                                pipe=pipe_idx.get(id(mp)))
+                if mp._ordering is not None:
+                    for piece in self._chunks(mp._ordering.flush(),
+                                              mp._ordering.last_release_count):
+                        self._push(mp, piece)
+                self._flush_pipe(mp)
+            for mp in self._all_pipes():
+                if mp.sink is not None:
+                    mp.sink.consume(None)
+            for mp in self._all_pipes():
+                if mp._chain is not None:
+                    mp._chain.sync_stats()
+            for op in self._operators:
+                op.close()            # closing_func per replica (svc_end parity)
+            self._ended = True
+            return self._results()
+        finally:
+            if self._monitor is not None:
+                self._monitor.finish(self)
 
     def getNumThreads(self) -> int:
         """API parity: total replicas across operators (the reference counts OS
@@ -616,6 +669,11 @@ class PipeGraph:
     def _deliver(self, mp: MultiPipe, out: Batch):
         if mp.sink is not None:
             mp.sink.consume(out)
+            if self._e2e_t0 is not None and self._monitor is not None:
+                import time as _time
+                self._monitor.registry.record_e2e(
+                    _time.perf_counter() - self._e2e_t0)
+                self._e2e_t0 = None    # one sample per sampled source batch
         if mp.split_fn is not None:
             self._push_split(mp, out)
         for merged in mp._outputs_to:

@@ -146,13 +146,27 @@ class CompiledChain:
             batch = jax.device_put(batch, self.device)
         self._push_count += 1
         # never sample push #1 — it would time JIT trace + XLA compile, not
-        # service; the first sample lands at push SERVICE_SAMPLE_EVERY
-        sampled = (self._push_count % self.SERVICE_SAMPLE_EVERY) == 0
+        # service. Early pushes sample at powers of two (2, 4, 8) so SHORT
+        # runs still carry service-time percentiles (the monitoring snapshot's
+        # p50/p95/p99 needs samples); steady state samples every
+        # SERVICE_SAMPLE_EVERY to keep the async pipeline overlapped.
+        c = self._push_count
+        sampled = ((c % self.SERVICE_SAMPLE_EVERY) == 0
+                   or (1 < c < self.SERVICE_SAMPLE_EVERY
+                       and (c & (c - 1)) == 0))
         t0 = time.perf_counter() if sampled else 0.0
         states, out = self._step_fn(from_op)(tuple(self.states), batch)
         if sampled:
             jax.block_until_ready(out)
             service_s = time.perf_counter() - t0
+            # sampled compiled-program launch -> the event journal (no-op —
+            # one None check — unless monitoring activated a journal)
+            from ..observability import journal as _journal
+            if _journal.get_active() is not None:
+                _journal.record(
+                    "launch", op=self.ops[from_op].getName() if self.ops else "",
+                    from_op=from_op, push=self._push_count,
+                    service_s=round(service_s, 6))
         else:
             service_s = None
         self.states = list(states)
@@ -196,6 +210,13 @@ class CompiledChain:
                     outs.append(fb)
         return outs
 
+    def sync_stats(self) -> None:
+        """Pull device-resident stats counters (e.g. window OLD-drop counts)
+        into every operator's host Stats_Record — called at EOS and by the
+        metrics registry at snapshot time."""
+        for op, st in zip(self.ops, self.states):
+            op.collect_stats(st)
+
     def result(self):
         """Results of any ReduceSink-style terminal ops (device accumulators)."""
         res = {}
@@ -211,7 +232,8 @@ class Pipeline:
 
     def __init__(self, source: SourceBase, ops: Sequence[Basic_Operator],
                  sink: Optional[Sink] = None, *,
-                 batch_size: Optional[int] = None, prefetch: int = 0):
+                 batch_size: Optional[int] = None, prefetch: int = 0,
+                 monitoring=None):
         self.source = source
         self.sink = sink
         if batch_size is None:
@@ -223,21 +245,55 @@ class Pipeline:
         cap = getattr(source, "out_capacity", lambda b: b)(batch_size)
         self.chain = CompiledChain(chain_ops, source.payload_spec(),
                                    batch_capacity=cap)
+        #: None = consult WF_MONITORING; True/str/MonitoringConfig = enable
+        #: (see observability.MonitoringConfig.resolve); resolved lazily so an
+        #: env change between construction and run() is honored
+        self._monitoring_arg = monitoring
+        self._monitor = None
 
     def run(self):
-        batches = (self.source.batches_prefetched(self.batch_size, self.prefetch)
-                   if self.prefetch else self.source.batches(self.batch_size))
-        for batch in batches:
-            record_source_launch(self.source, batch)
-            out = self.chain.push(batch)
+        import time as _time
+        from ..observability import Monitor, MonitoringConfig
+        cfg = MonitoringConfig.resolve(self._monitoring_arg)
+        if cfg is not None and self._monitor is None:
+            self._monitor = Monitor(cfg, self.source.getName() + "-pipeline")
+            self._monitor.registry.register_pipeline(self)
+            self._monitor.start()
+        mon = self._monitor
+        try:
+            batches = (self.source.batches_prefetched(self.batch_size,
+                                                      self.prefetch)
+                       if self.prefetch else self.source.batches(self.batch_size))
+            n = 0
+            for batch in batches:
+                record_source_launch(self.source, batch)
+                # e2e sampling needs a host sink (its consume blocks on the
+                # materialized result — the "receipt"); in-graph ReduceSinks
+                # have no host receipt to time
+                sampled = (mon is not None and self.sink is not None
+                           and mon.config.should_sample_e2e(n))
+                t0 = _time.perf_counter() if sampled else 0.0
+                out = self.chain.push(batch)
+                if self.sink is not None:
+                    self.sink.consume(out)
+                if sampled:
+                    # Sink.consume materialized the batch on the host (or the
+                    # sink is in-graph) — this is a true source-framing ->
+                    # host-receipt sample through device compute + transfer
+                    mon.registry.record_e2e(_time.perf_counter() - t0)
+                n += 1
+            from ..observability import journal as _journal
+            _journal.record("eos", pipeline=self.source.getName())
+            for out in self.chain.flush():
+                if self.sink is not None:
+                    self.sink.consume(out)
             if self.sink is not None:
-                self.sink.consume(out)
-        for out in self.chain.flush():
-            if self.sink is not None:
-                self.sink.consume(out)
-        if self.sink is not None:
-            self.sink.consume(None)   # empty-optional EOS signal (wf/sink.hpp)
-        for op in [self.source, *self.chain.ops,
-                   *([self.sink] if self.sink is not None else [])]:
-            op.close()                # closing_func per replica (svc_end parity)
-        return self.chain.result()
+                self.sink.consume(None)  # empty-optional EOS signal (wf/sink.hpp)
+            self.chain.sync_stats()
+            for op in [self.source, *self.chain.ops,
+                       *([self.sink] if self.sink is not None else [])]:
+                op.close()            # closing_func per replica (svc_end parity)
+            return self.chain.result()
+        finally:
+            if mon is not None:
+                mon.finish(self)

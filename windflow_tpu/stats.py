@@ -16,6 +16,8 @@ import json
 import os
 import time
 
+from .observability.metrics import LogHistogram
+
 
 class Stats_Record:
     def __init__(self, op_name: str, replica_id: int = 0):
@@ -32,8 +34,14 @@ class Stats_Record:
         self.num_kernels = 0          # compiled-program launches
         self.bytes_copied_hd = 0      # host -> HBM
         self.bytes_copied_dh = 0      # HBM -> host
+        #: tuples discarded as OLD (behind the fired-window frontier) by TB
+        #: window engines — synced from device state via ``collect_stats``
+        self.tuples_dropped_old = 0
         self._service_time_sum = 0.0
         self._service_samples = 0
+        #: log-bucket distribution of the sampled service times (p50/p95/p99
+        #: via observability.MetricsRegistry; one bisect per SAMPLED launch)
+        self.service_hist = LogHistogram()
 
     def record_input(self, n_tuples: int, n_bytes: int = 0):
         self.inputs_received += int(n_tuples)
@@ -57,6 +65,7 @@ class Stats_Record:
         if service_time_s is not None:
             self._service_time_sum += float(service_time_s)
             self._service_samples += 1
+            self.service_hist.record(service_time_s)
 
     @property
     def avg_service_time_us(self) -> float:
@@ -77,7 +86,9 @@ class Stats_Record:
             "num_kernels": self.num_kernels,
             "bytes_copied_hd": self.bytes_copied_hd,
             "bytes_copied_dh": self.bytes_copied_dh,
+            "tuples_dropped_old": self.tuples_dropped_old,
             "avg_service_time_us": self.avg_service_time_us,
+            "service_time_us": self.service_hist.summary_us(),
             "uptime_s": time.monotonic() - self.start_time,
         }
 
