@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Chaos sweep: run N seeded fault plans through all three drivers and report
+any divergence from the fault-free baseline.
+
+For each seed a probabilistic FaultPlan (errors on source.next / chain.step /
+sink.consume for the supervised drivers, stalls on queue.stall for the
+threaded driver) is injected via runtime/faults.py; the run's outputs must be
+byte-identical to the fault-free oracle (exactly-once under injection).
+Exit code 0 = no divergence, 1 = at least one.
+
+    JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --total 400
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np                                        # noqa: E402
+import jax.numpy as jnp                                   # noqa: E402
+
+import windflow_tpu as wf                                 # noqa: E402
+from windflow_tpu.basic import win_type_t                 # noqa: E402
+from windflow_tpu.operators.window import WindowSpec      # noqa: E402
+from windflow_tpu.runtime import faults as faults_mod     # noqa: E402
+from windflow_tpu.runtime.faults import (FaultInjector,   # noqa: E402
+                                         FaultPlan, FaultSpec)
+from windflow_tpu.runtime.pipegraph import PipeGraph      # noqa: E402
+from windflow_tpu.runtime.supervisor import SupervisedPipeline  # noqa: E402
+from windflow_tpu.runtime.threaded import ThreadedPipeline      # noqa: E402
+
+
+def collect(acc):
+    def cb(view):
+        if view is None:
+            return
+        acc.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                       np.asarray(view["payload"]).tolist()))
+    return cb
+
+
+def run_pipeline(total, batch, faults=None):
+    got = []
+    src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+                    total=total, num_keys=4)
+    op = wf.Win_Seq(lambda wid, it: it.sum("v"),
+                    WindowSpec(10, 10, win_type_t.TB), num_keys=4)
+    SupervisedPipeline(src, [op], wf.Sink(collect(got)), batch_size=batch,
+                       checkpoint_every=3, max_restarts=8,
+                       backoff_base=0.001, backoff_cap=0.01,
+                       faults=faults).run()
+    return sorted(got)
+
+
+def run_graph(total, batch, faults=None):
+    got = []
+    g = PipeGraph("sweep", batch_size=batch)
+    a = g.add_source(wf.Source(lambda i: {"v": (i % 9).astype(jnp.float32)},
+                               total=total, num_keys=3, name="a"))
+    b = g.add_source(wf.Source(lambda i: {"v": (i % 7).astype(jnp.float32)},
+                               total=total // 2, num_keys=3, name="b"))
+    (a.merge(b)
+     .add(wf.Win_Seq(lambda wid, it: it.sum("v"),
+                     WindowSpec(12, 12, win_type_t.CB), num_keys=3))
+     .add_sink(wf.Sink(collect(got))))
+    g.run_supervised(checkpoint_every=3, max_restarts=8,
+                     backoff_base=0.001, backoff_cap=0.01, faults=faults)
+    return sorted(got)
+
+
+def run_threaded(total, batch, faults=None):
+    got = []
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=total)
+    ThreadedPipeline(src, [[wf.Map(lambda t: {"v": t.v * 3})],
+                           [wf.Map(lambda t: {"v": t.v + 1})]],
+                     wf.Sink(lambda v: got.extend(
+                         zip(v["id"].tolist(),
+                             np.asarray(v["payload"]["v"]).tolist()))
+                         if v is not None else None),
+                     batch_size=batch, pin=False, heartbeat_timeout=0.25,
+                     faults=faults).run()
+    return sorted(got)
+
+
+def plan_for(seed, threaded=False):
+    if threaded:
+        # the threaded driver has no replay machinery: stalls only (delay,
+        # never drop) — the watchdog must notice, results must not change
+        return FaultPlan([FaultSpec("queue.stall", kind="stall", p=0.15,
+                                    stall_s=0.4)], seed=seed)
+    return FaultPlan([FaultSpec("source.next", p=0.06),
+                      FaultSpec("chain.step", p=0.08),
+                      FaultSpec("sink.consume", p=0.10)], seed=seed)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--total", type=int, default=400)
+    ap.add_argument("--batch", type=int, default=40)
+    args = ap.parse_args()
+
+    drivers = {"pipeline": run_pipeline, "graph": run_graph,
+               "threaded": run_threaded}
+    baselines = {}
+    for name, fn in drivers.items():
+        t0 = time.time()
+        baselines[name] = fn(args.total, args.batch)
+        print(f"[baseline] {name}: {len(baselines[name])} results "
+              f"({time.time() - t0:.1f}s)")
+
+    divergences = 0
+    for seed in range(args.seeds):
+        for name, fn in drivers.items():
+            inj = FaultInjector(plan_for(seed, threaded=(name == "threaded")))
+            t0 = time.time()
+            try:
+                out = fn(args.total, args.batch, faults=inj)
+            except Exception as e:          # noqa: BLE001
+                print(f"[seed {seed}] {name}: RUN FAILED {type(e).__name__}: "
+                      f"{e} ({len(inj.fired)} faults injected)")
+                divergences += 1
+                continue
+            ok = out == baselines[name]
+            print(f"[seed {seed}] {name}: {len(inj.fired)} faults injected, "
+                  f"{'OK' if ok else 'DIVERGED'} ({time.time() - t0:.1f}s)")
+            if not ok:
+                divergences += 1
+                missing = set(baselines[name]) - set(out)
+                extra = set(out) - set(baselines[name])
+                print(f"            missing={len(missing)} extra={len(extra)}")
+    ctr = faults_mod.counters()
+    print(f"\ncounters: {ctr}")
+    if divergences:
+        print(f"FAIL: {divergences} divergent run(s)")
+        return 1
+    print("PASS: all chaos runs byte-identical to the fault-free baselines")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,3 +19,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) >= 8, "tests need the 8-device virtual CPU mesh"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 gate "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection tests "
+        "(runtime/faults.py harness); fast ones stay in tier-1")

@@ -150,3 +150,91 @@ def test_load_chain_gap_in_leaves_still_raises(tmp_path):
     np.savez(ckpt, **data)
     with pytest.raises(KeyError, match="missing op0_leaf0"):
         load_chain(chain, ckpt)
+
+
+def test_save_load_path_without_npz_suffix(tmp_path):
+    """np.savez appends .npz when the suffix is missing; the pre-fix code
+    resolved the path only on save, so save_chain('ckpt') + load_chain('ckpt')
+    disagreed. Both now resolve through checkpoint.resolve_path."""
+    import windflow_tpu as wf
+    src = wf.Source(lambda i: {"v": (i % 5).astype(jnp.float32)},
+                    total=128, num_keys=2)
+    mk = lambda: CompiledChain(
+        [Key_FFAT(lambda t: t.v, jnp.add, spec=WindowSpec(8, 8), num_keys=2)],
+        src.payload_spec(), batch_capacity=32)
+    c1 = mk()
+    for b in src.batches(32):
+        c1.push(b)
+        break
+    stem = str(tmp_path / "ckpt")             # NO .npz suffix
+    written = save_chain(c1, stem, meta={"k": 9})
+    assert written.endswith("ckpt.npz")
+    c2 = mk()
+    assert load_chain(c2, stem) == {"k": 9}   # same suffix-free path
+
+
+def test_checksum_detects_corruption(tmp_path):
+    """Flipped bytes inside a stored array fail the per-array sha256 and raise
+    CheckpointCorrupt instead of silently restoring garbage."""
+    import pytest
+    from windflow_tpu.runtime.checkpoint import CheckpointCorrupt
+    import windflow_tpu as wf
+    src = wf.Source(lambda i: {"v": (i % 5).astype(jnp.float32)},
+                    total=256, num_keys=2)
+    mk = lambda: CompiledChain(
+        [Key_FFAT(lambda t: t.v, jnp.add, spec=WindowSpec(8, 8), num_keys=2)],
+        src.payload_spec(), batch_capacity=64)
+    c1 = mk()
+    for b in src.batches(64):
+        c1.push(b)
+    ckpt = str(tmp_path / "c.npz")
+    save_chain(c1, ckpt)
+    data = dict(np.load(ckpt))
+    key = next(k for k in data if k.startswith("op0_leaf")
+               and data[k].size > 4 and data[k].dtype.kind == "f")
+    data[key] = data[key].copy()
+    data[key].flat[1] += 1234.5               # bit rot
+    np.savez(ckpt, **data)
+    with pytest.raises(CheckpointCorrupt, match="sha256"):
+        load_chain(mk(), ckpt)
+
+
+def test_lineage_falls_back_to_newest_valid(tmp_path):
+    """keep=K lineage: a torn/corrupt NEWEST checkpoint restores from the
+    previous valid one (journaled fallback); when every entry is bad the
+    restore fails loudly."""
+    import pytest
+    from windflow_tpu.runtime import faults as faults_mod
+    from windflow_tpu.runtime.checkpoint import (CheckpointCorrupt,
+                                                 manifest_path, _read_manifest)
+    import windflow_tpu as wf
+    faults_mod.reset_counters()
+    src = wf.Source(lambda i: {"v": (i % 5).astype(jnp.float32)},
+                    total=256, num_keys=2)
+    mk = lambda: CompiledChain(
+        [Key_FFAT(lambda t: t.v, jnp.add, spec=WindowSpec(8, 8), num_keys=2)],
+        src.payload_spec(), batch_capacity=64)
+    c1 = mk()
+    stem = str(tmp_path / "lin.npz")
+    files = []
+    for n, b in enumerate(src.batches(64)):
+        c1.push(b)
+        files.append(save_chain(c1, stem, meta={"n": n}, keep=2))
+    man = _read_manifest(manifest_path(stem))
+    assert len(man["entries"]) == 2           # pruned to keep
+    import os
+    assert not os.path.exists(files[0])       # oldest rotated out
+    # torn newest: truncate to half
+    raw = open(files[-1], "rb").read()
+    open(files[-1], "wb").write(raw[:len(raw) // 2])
+    c2 = mk()
+    meta = load_chain(c2, stem)
+    assert meta == {"n": len(files) - 2}      # previous commit restored
+    ctr = faults_mod.counters()
+    assert ctr["checkpoint_corrupt_skipped"] >= 1
+    assert ctr["checkpoint_fallbacks"] >= 1
+    # every entry torn -> loud failure
+    raw2 = open(files[-2], "rb").read()
+    open(files[-2], "wb").write(raw2[:len(raw2) // 2])
+    with pytest.raises(CheckpointCorrupt, match="no valid checkpoint"):
+        load_chain(mk(), stem)

@@ -129,3 +129,20 @@ def test_supervised_deterministic_merge_recovers():
     g.run_supervised(checkpoint_every=2, max_restarts=3)
     assert g.supervised_restarts == 2
     assert sorted(sup) == sorted(plain) and len(plain) > 0
+
+
+def test_shims_uninstalled_even_when_recovery_fails():
+    """The _CommitBufferSink output-commit shims must be removed by the
+    ``finally`` in run_graph_supervised on EVERY exit path — after a
+    RestartExhausted each pipe's sink is the original user Sink again."""
+    w, p, wc, pc = collectors()
+    g = build(wc, pc)
+    sinks_before = [mp.sink for mp in g._all_pipes() if mp.sink is not None]
+    inject_failures(g, fail_at=[2, 3, 4, 5, 6])
+    with pytest.raises(RestartExhausted) as ei:
+        g.run_supervised(checkpoint_every=100, max_restarts=3,
+                         backoff_base=0.0)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    sinks_after = [mp.sink for mp in g._all_pipes() if mp.sink is not None]
+    assert sinks_after == sinks_before
+    assert all(isinstance(s, wf.Sink) for s in sinks_after)

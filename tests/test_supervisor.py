@@ -156,3 +156,69 @@ def test_spill_checkpoint_written(tmp_path):
     import numpy as np
     data = np.load(path)
     assert "__meta__" in data
+
+
+def test_budget_refills_on_commit_progress():
+    """max_restarts bounds failures PER checkpoint interval: three faults in
+    three different intervals recover with a budget of one, because each
+    commit refills it."""
+    oracle = []
+    build(collect(oracle)).run()
+
+    got = []
+    p = build(collect(got), checkpoint_every=2, max_restarts=1)
+    # pushes 2, 6, 10 land in distinct intervals (replays shift the counts:
+    # each failure re-pushes the interval's batches before the next commit)
+    p.chain.push = Flaky(p.chain, [2, 6, 10])
+    p.run()
+    assert p.restarts == 3
+    assert sorted(got) == sorted(oracle)
+
+
+def test_restart_exhausted_carries_cause():
+    got = []
+    p = build(collect(got), checkpoint_every=4, max_restarts=1)
+    boom = RuntimeError("the real device fault")
+
+    def always_fail(batch):
+        raise boom
+    p.chain.push = always_fail
+    with pytest.raises(RestartExhausted) as ei:
+        p.run()
+    assert ei.value.__cause__ is boom
+
+
+def test_reopen_source_fast_forwards_pre_cursor_signature():
+    """A legacy/user source whose ``batches`` predates the cursor kwarg is
+    detected via inspect.signature and fast-forwarded — not probed by calling
+    it and swallowing TypeError."""
+    from windflow_tpu.runtime.supervisor import _reopen_source
+
+    class Legacy:
+        def __init__(self):
+            self.opens = 0
+
+        def batches(self, batch_size):
+            self.opens += 1
+            for i in range(8):
+                yield i
+
+    src = Legacy()
+    it = _reopen_source(src, 50, 3, cursor={"batch": 3})
+    assert next(it) == 3 and src.opens == 1
+
+
+def test_reopen_source_genuine_typeerror_propagates():
+    """A TypeError raised BY a cursor-accepting source must propagate — the
+    pre-fix ``except TypeError`` fallback silently masked it behind a
+    from-zero replay (wrong data, no error)."""
+    from windflow_tpu.runtime.supervisor import _reopen_source
+
+    class Buggy:
+        def batches(self, batch_size, cursor=None):
+            raise TypeError("genuine bug inside the source")
+            yield  # pragma: no cover
+
+    with pytest.raises(TypeError, match="genuine bug"):
+        it = _reopen_source(Buggy(), 50, 3, cursor={"batch": 3})
+        next(it)

@@ -30,7 +30,9 @@ from .observability import (MetricsRegistry, MonitoringConfig, Reporter,
                             EventJournal, LogHistogram, read_journal,
                             topology_dot, topology_json)
 from .runtime.async_sink import AsyncResultShipper, ShippedResult
-from .runtime.checkpoint import save_chain, load_chain
+from .runtime.checkpoint import save_chain, load_chain, CheckpointCorrupt
+from .runtime.faults import (FaultPlan, FaultSpec, FaultInjector,
+                             InjectedFault, WatchdogTimeout, DeadLetterQueue)
 from .operators.source import prefetch_to_device
 from .parallel import make_mesh, make_mesh_2d
 from .parallel.sharding import ShardedChain, shard_batch

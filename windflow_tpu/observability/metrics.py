@@ -104,6 +104,13 @@ _COUNTERS = ("inputs_received", "outputs_sent", "bytes_received", "bytes_sent",
              "bytes_copied_hd", "bytes_copied_dh", "tuples_dropped_old")
 
 
+def _recovery_counters() -> Dict[str, float]:
+    """Process-wide supervision counters (lazy import: runtime.faults imports
+    observability.journal, so the reverse edge must not exist at import time)."""
+    from ..runtime import faults as _faults
+    return _faults.counters()
+
+
 class MetricsRegistry:
     """Aggregates every ``Stats_Record`` of a running graph into one snapshot.
 
@@ -318,6 +325,10 @@ class MetricsRegistry:
             "e2e_latency_us": self.e2e_hist.summary_us(),
             "queues": queues,
             "ordering": orderings,
+            # process-wide recovery/chaos counters (restarts, backoff sleeps,
+            # dead-lettered poison batches, checkpoint validation outcomes,
+            # watchdog timeouts, injected faults) — runtime/faults.py
+            "recovery": _recovery_counters(),
         }
         if gauges:
             snap["gauges"] = gauges
@@ -388,6 +399,11 @@ class MetricsRegistry:
                              f'{{{lab},le="{le_s}"}} {acc}')
             lines.append(f'windflow_e2e_latency_seconds_sum{{{lab}}} {h.sum:.9g}')
             lines.append(f'windflow_e2e_latency_seconds_count{{{lab}}} {h.count}')
+        recovery = snap.get("recovery") or _recovery_counters()
+        for k, v in sorted(recovery.items()):
+            lines.append(f"# TYPE windflow_recovery_{k}_total counter")
+            lines.append(f'windflow_recovery_{k}_total{{graph="{esc(g)}"}} '
+                         f'{round(v, 6)}')
         lines.append(f'windflow_uptime_seconds{{graph="{esc(g)}"}} '
                      f'{snap["uptime_s"]}')
         return "\n".join(lines) + "\n"

@@ -1,4 +1,4 @@
-"""Checkpoint / resume of pipeline state.
+"""Checkpoint / resume of pipeline state, with durable lineage.
 
 The reference has NO checkpointing (SURVEY §5: all operator state — keyMaps, archives,
 FlatFATs — is in-memory and lost at exit). Here every operator's state is a pytree of
@@ -6,17 +6,61 @@ device arrays threaded through the compiled step, so checkpointing is structural
 ``save_chain`` snapshots each operator's state (plus stream-position metadata) to an
 ``.npz``; ``load_chain`` restores it. Works for any CompiledChain (and therefore any
 Pipeline / PipeGraph segment).
+
+Durability hardening (the chaos-harness findings):
+
+- **Atomic writes**: the ``.npz`` is written to a temp file in the target
+  directory and ``os.replace``-d into place — a crash mid-write can never
+  leave a torn file under the checkpoint's name.
+- **Checksums**: ``__meta__`` carries a per-array sha256 map; ``load_chain``
+  verifies every present array before touching the chain (bit-rot and
+  tampering fail loudly as :class:`CheckpointCorrupt`, never a silent
+  partial restore). Pre-checksum checkpoints load without verification.
+- **Lineage** (``keep > 1``): successive saves rotate through
+  ``<stem>.<seq>.npz`` files tracked by a ``<stem>.manifest.json`` (atomic,
+  with whole-file sha256 per entry, pruned to the last ``keep``);
+  ``load_chain`` walks the manifest newest→oldest and restores the newest
+  *valid* checkpoint, so one torn/corrupt file degrades to the previous
+  commit instead of losing the state entirely.
+- ``path`` is resolved ONCE (``.npz`` appended when missing) and used for both
+  save and load — ``save_chain("ckpt")`` / ``load_chain("ckpt")`` now agree.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
-from typing import Any, Dict
+import os
+import tempfile
+import time
+from typing import Dict, Optional
 
 import jax
 import numpy as np
 
+from . import faults as _faults
+from ..observability import journal as _journal
 from .pipeline import CompiledChain
+
+#: reserved __meta__ keys (stripped from the dict load_chain returns)
+_META_SHA = "__sha256__"
+_META_SEQ = "__seq__"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file is torn, truncated, or fails checksum verification
+    (and, for a lineage, no older entry is valid either)."""
+
+
+def resolve_path(path: str) -> str:
+    """THE path normalization, shared by save and load: ``np.savez`` appends
+    ``.npz`` when the suffix is missing, so resolve it once up front."""
+    path = str(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def manifest_path(path: str) -> str:
+    return resolve_path(path)[:-len(".npz")] + ".manifest.json"
 
 
 def _flatten(states) -> Dict[str, np.ndarray]:
@@ -28,15 +72,160 @@ def _flatten(states) -> Dict[str, np.ndarray]:
     return out
 
 
-def save_chain(chain: CompiledChain, path: str, *, meta: dict = None) -> None:
+def _digest_map(arrays: Dict[str, np.ndarray]) -> Dict[str, str]:
+    """Per-array sha256 (dtype + shape + bytes) — per-array so the legacy
+    grown-field tolerance (a checkpoint missing TRAILING leaves of a state
+    that later grew) keeps working: only present arrays are verified."""
+    out = {}
+    for k in sorted(arrays):
+        h = hashlib.sha256()
+        a = np.ascontiguousarray(arrays[k])
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+        out[k] = h.hexdigest()
+    return out
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _serialize(arrays: Dict[str, np.ndarray], meta: dict) -> Dict[str, np.ndarray]:
+    out = dict(arrays)
+    out["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    return out
+
+
+def _to_npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Serialize once to memory — the same bytes feed the atomic write AND the
+    manifest's whole-file sha256, so a lineage save never re-reads the file it
+    just wrote."""
+    import io
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _atomic_write_bytes(path: str, raw: bytes) -> None:
+    """Write to a temp file in the target directory, then ``os.replace`` —
+    readers see the old file or the new file, never a torn one (the
+    pre-hardening ``np.savez(path)`` could be interrupted mid-write)."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _write_torn(path: str, raw: bytes, spec) -> None:
+    """Injected torn write: leave HALF the serialized bytes under the real
+    checkpoint name (simulating a crashed non-atomic writer / bit rot), then
+    raise — what `load_chain` must survive via the lineage fallback."""
+    with open(path, "wb") as f:
+        f.write(raw[:max(1, len(raw) // 2)])
+    raise _faults.InjectedFault(
+        spec.message or f"injected torn checkpoint write at {path}")
+
+
+def _read_manifest(mpath: str) -> Optional[dict]:
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (json.JSONDecodeError, OSError):
+        return None      # a torn manifest degrades to single-file behavior
+
+
+def _write_manifest(mpath: str, man: dict) -> None:
+    d = os.path.dirname(mpath) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(mpath) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(man, f, indent=1)
+        os.replace(tmp, mpath)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_chain(chain: CompiledChain, path: str, *, meta: dict = None,
+               keep: int = 1) -> str:
+    """Snapshot ``chain.states`` (+ ``meta``) to ``path`` atomically; returns
+    the file actually written.
+
+    ``keep > 1`` enables lineage mode: each save writes a new
+    ``<stem>.<seq>.npz`` and updates ``<stem>.manifest.json`` (entries carry a
+    whole-file sha256; pruned to the last ``keep`` files). ``load_chain`` on
+    the same ``path`` then restores the newest valid entry."""
+    path = resolve_path(path)
     arrays = _flatten(chain.states)
-    arrays["__meta__"] = np.frombuffer(
-        json.dumps(meta or {}).encode(), dtype=np.uint8)
-    np.savez(path, **arrays)
+    full_meta = dict(meta or {})
+    full_meta[_META_SHA] = _digest_map(arrays)
+    spec = _faults.decision("checkpoint.save", path=path)
+    if keep <= 1:
+        raw = _to_npz_bytes(_serialize(arrays, full_meta))
+        if spec is not None:
+            if spec.kind == "torn":
+                _write_torn(path, raw, spec)
+            raise _faults.InjectedFault(
+                spec.message or f"injected checkpoint.save fault at {path}")
+        _atomic_write_bytes(path, raw)
+        _faults.bump("checkpoint_saves")
+        return path
+    # -- lineage mode ------------------------------------------------------
+    mpath = manifest_path(path)
+    man = _read_manifest(mpath) or {"version": 1, "stem": os.path.basename(path),
+                                    "entries": []}
+    entries = man["entries"]
+    seq = (entries[-1]["seq"] + 1) if entries else 0
+    full_meta[_META_SEQ] = seq
+    file = f"{path[:-len('.npz')]}.{seq:06d}.npz"
+    raw = _to_npz_bytes(_serialize(arrays, full_meta))
+    if spec is not None:
+        if spec.kind == "torn":
+            # crash mid-write: the torn file exists but never reaches the
+            # manifest — exactly the artifact restore must tolerate
+            _write_torn(file, raw, spec)
+        raise _faults.InjectedFault(
+            spec.message or f"injected checkpoint.save fault at {file}")
+    _atomic_write_bytes(file, raw)
+    entries.append({"file": os.path.basename(file), "seq": seq,
+                    "sha256": hashlib.sha256(raw).hexdigest(),
+                    "wall": time.time(),
+                    "meta": {k: v for k, v in (meta or {}).items()}})
+    while len(entries) > keep:
+        old = entries.pop(0)
+        try:
+            os.unlink(os.path.join(os.path.dirname(path) or ".", old["file"]))
+        except OSError:
+            pass
+    _write_manifest(mpath, man)
+    _faults.bump("checkpoint_saves")
+    return file
 
 
-def load_chain(chain: CompiledChain, path: str) -> dict:
-    """Restore states in place; returns the saved metadata dict.
+def _restore_file(chain: CompiledChain, path: str,
+                  expect_file_sha: Optional[str] = None) -> dict:
+    """Verify + restore one checkpoint file in place; returns the user meta.
 
     Legacy compatibility: a checkpoint written before a state dataclass grew a
     trailing field (e.g. Win_SeqFFAT's ``dropped_old`` counter) is short by
@@ -44,8 +233,27 @@ def load_chain(chain: CompiledChain, path: str) -> dict:
     missing keys are exactly the tail. Absent leaves keep the chain's
     freshly-initialized value (zeros for counters) instead of raising — the
     same stance as the supervisor's legacy-``wm`` mapping."""
-    data = np.load(path)
+    if expect_file_sha is not None and _file_sha256(path) != expect_file_sha:
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} fails its manifest sha256 — torn or corrupt")
+    try:
+        data = np.load(path)
+    except Exception as e:                 # noqa: BLE001 — torn zip/npz
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} is unreadable ({type(e).__name__}: {e})"
+        ) from e
+    raw = data.get("__meta__")
+    meta = json.loads(bytes(raw).decode()) if raw is not None else {}
+    sha_map = meta.pop(_META_SHA, None)
+    meta.pop(_META_SEQ, None)
     present = set(getattr(data, "files", []))
+    if sha_map:
+        for k in sorted(present - {"__meta__"}):
+            want = sha_map.get(k)
+            if want is not None and _digest_map({k: data[k]})[k] != want:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path!r}: array {k} fails its sha256 — "
+                    f"corrupt data, refusing a silent partial restore")
     new_states = []
     for i, st in enumerate(chain.states):
         leaves, treedef = jax.tree.flatten(st)
@@ -68,5 +276,43 @@ def load_chain(chain: CompiledChain, path: str) -> dict:
                     else leaves[j] for j in range(len(leaves))]
         new_states.append(jax.tree.unflatten(treedef, restored))
     chain.states = new_states
-    raw = data.get("__meta__")
-    return json.loads(bytes(raw).decode()) if raw is not None else {}
+    return meta
+
+
+def load_chain(chain: CompiledChain, path: str) -> dict:
+    """Restore states in place; returns the saved metadata dict.
+
+    When ``path`` has a lineage manifest (``save_chain(..., keep=K)``), walks
+    the entries newest→oldest and restores the newest checkpoint that passes
+    verification — a torn or corrupt latest file falls back to the previous
+    commit (journaled as ``checkpoint_fallback``) instead of failing the
+    restore. Without a manifest, a single invalid file raises
+    :class:`CheckpointCorrupt` (or ``KeyError`` for a chain mismatch)."""
+    path = resolve_path(path)
+    _faults.fire("checkpoint.load", path=path)
+    man = _read_manifest(manifest_path(path))
+    if man and man.get("entries"):
+        d = os.path.dirname(path) or "."
+        last_err: Optional[Exception] = None
+        skipped = []
+        for ent in reversed(man["entries"]):
+            f = os.path.join(d, ent["file"])
+            try:
+                meta = _restore_file(chain, f,
+                                     expect_file_sha=ent.get("sha256"))
+            except (CheckpointCorrupt, KeyError, OSError) as e:
+                last_err = e
+                skipped.append(ent["file"])
+                _faults.bump("checkpoint_corrupt_skipped")
+                _journal.record("checkpoint_invalid", path=f,
+                                error=type(e).__name__)
+                continue
+            if skipped:
+                _faults.bump("checkpoint_fallbacks")
+                _journal.record("checkpoint_fallback", restored=ent["file"],
+                                skipped=skipped)
+            return meta
+        raise CheckpointCorrupt(
+            f"no valid checkpoint in lineage {path!r} "
+            f"({len(man['entries'])} entries, all torn/corrupt)") from last_err
+    return _restore_file(chain, path)

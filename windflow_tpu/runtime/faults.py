@@ -1,0 +1,443 @@
+"""Deterministic fault injection + the supervision hardening primitives.
+
+The reference's entire failure model is ``exit(EXIT_FAILURE)`` (SURVEY §5);
+the supervisor (``runtime/supervisor.py``) goes far beyond it — but a recovery
+path that is never exercised is an assumption, not a capability. This module
+makes failure a first-class, *testable* input to every driver:
+
+- :class:`FaultPlan` / :class:`FaultInjector`: a seeded, fully deterministic
+  schedule of faults at **named injection sites** threaded through the runtime
+  (``source.next``, ``chain.step``, ``sink.consume``, ``checkpoint.save``,
+  ``checkpoint.load``, ``queue.stall``). Programmatic (``faults=`` kwarg on the
+  supervised/threaded drivers) or via the ``WF_FAULT_PLAN`` env (inline JSON or
+  a path to a JSON file). Every injected fault is journaled through the
+  observability EventJournal (``fault_injected`` events) together with the
+  recovery it triggered, so a chaos run's artifact shows the full sequence.
+- :func:`call_with_timeout`: the step watchdog — converts a hung device step
+  into a detectable :class:`WatchdogTimeout` the supervisor recovers from.
+- :func:`backoff_sleep`: exponential backoff with decorrelated jitter between
+  restart attempts (sleep ~ ``U(base, 3*prev)`` capped), so a flapping device
+  cannot be hammered in a tight restart loop.
+- :class:`DeadLetterQueue`: the poison-batch quarantine target — a malformed
+  input that keeps failing replay is routed here (in-memory, optional JSONL
+  spill) and skipped instead of exhausting the restart budget.
+- process-wide recovery counters (:func:`counters`) that flow into the
+  observability ``MetricsRegistry`` snapshot and Prometheus exposition.
+
+Injection sites cost one module-attribute load + ``None`` check when no
+injector is active — the same stance as the event journal.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..observability import journal as _journal
+
+#: the named injection sites threaded through the runtime drivers
+SITES = ("source.next", "chain.step", "sink.consume",
+         "checkpoint.save", "checkpoint.load", "queue.stall")
+
+#: fault kinds: raise an InjectedFault / sleep stall_s (watchdog + queue-stall
+#: exercise) / leave a half-written checkpoint behind, then raise (torn write)
+KINDS = ("error", "stall", "torn")
+
+
+class InjectedFault(RuntimeError):
+    """Raised at an injection site by an active :class:`FaultInjector`."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """A supervised step (or threaded stage) exceeded its watchdog timeout —
+    a hang converted into a detectable, recoverable fault."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One scheduled fault. Matching conditions AND together:
+
+    - ``at``: 1-based per-site occurrence indices (deterministic single shots);
+    - ``where``: equality constraints on the call-site context (e.g.
+      ``{"pos": 5}`` — fires **every** time batch position 5 is processed,
+      which is how a deterministic poison batch is modelled);
+    - ``p``: per-occurrence probability drawn from the plan's seeded RNG
+      (chaos sweeps).
+
+    With none of the three, the spec fires on the first occurrence only.
+    ``max_fires`` bounds total fires (default: unlimited for ``where``/``p``
+    specs, ``len(at)`` for ``at`` specs, 1 otherwise).
+    """
+
+    site: str
+    kind: str = "error"
+    at: Optional[Sequence[int]] = None
+    where: Optional[Dict[str, Any]] = None
+    p: float = 0.0
+    stall_s: float = 0.05
+    max_fires: Optional[int] = None
+    message: str = ""
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r} "
+                             f"(sites: {', '.join(SITES)})")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(kinds: {', '.join(KINDS)})")
+        if self.at is not None:
+            self.at = tuple(int(a) for a in self.at)
+
+    def _fire_bound(self) -> Optional[int]:
+        if self.max_fires is not None:
+            return int(self.max_fires)
+        if self.at is not None:
+            return len(self.at)
+        if self.where is not None or self.p > 0.0:
+            return None                      # unlimited
+        return 1
+
+
+class FaultPlan:
+    """An ordered, seeded set of :class:`FaultSpec`. JSON round-trippable:
+
+    ``{"seed": 7, "faults": [{"site": "chain.step", "at": [3]}, ...]}``
+    """
+
+    def __init__(self, faults: Sequence[FaultSpec] = (), *, seed: int = 0):
+        self.faults = [f if isinstance(f, FaultSpec) else FaultSpec(**f)
+                       for f in faults]
+        self.seed = int(seed)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "faults": [{k: v for k, v in dataclasses.asdict(f).items()
+                        if v not in (None, "", 0.0) or k in ("site", "kind")}
+                       for f in self.faults]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        obj = json.loads(text)
+        if isinstance(obj, list):            # bare fault list shorthand
+            obj = {"faults": obj}
+        return cls(obj.get("faults", ()), seed=obj.get("seed", 0))
+
+    @classmethod
+    def from_env(cls, var: str = "WF_FAULT_PLAN") -> Optional["FaultPlan"]:
+        """``WF_FAULT_PLAN`` = inline JSON (starts with ``{``/``[``) or a path
+        to a JSON file; empty/unset = no plan."""
+        raw = os.environ.get(var, "").strip()
+        if not raw:
+            return None
+        if raw[0] in "[{":
+            return cls.from_json(raw)
+        with open(raw) as f:
+            return cls.from_json(f.read())
+
+
+class FaultInjector:
+    """Evaluates a :class:`FaultPlan` at the runtime's injection sites.
+
+    Deterministic: per-site occurrence counters plus one ``random.Random``
+    seeded per spec from ``plan.seed`` — the same plan against the same
+    (single-threaded) driver fires at the same occurrences every run.
+    Thread-safe (the threaded driver fires from several stage threads).
+    ``fired`` records every fire: ``(site, occurrence, kind, ctx)``.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.counts: Dict[str, int] = {}
+        self.fired: List[Tuple[str, int, str, dict]] = []
+        self._spec_fires = [0] * len(plan.faults)
+        self._rngs = [random.Random(f"{plan.seed}/{i}/{s.site}")
+                      for i, s in enumerate(plan.faults)]
+        self._lock = threading.Lock()
+
+    def decision(self, site: str, **ctx) -> Optional[FaultSpec]:
+        """Count one occurrence of ``site`` and return the matching spec (or
+        None) WITHOUT acting on it — call sites with special semantics (torn
+        checkpoint writes) implement the fault themselves."""
+        with self._lock:
+            n = self.counts.get(site, 0) + 1
+            self.counts[site] = n
+            for i, spec in enumerate(self.plan.faults):
+                if spec.site != site:
+                    continue
+                bound = spec._fire_bound()
+                if bound is not None and self._spec_fires[i] >= bound:
+                    continue
+                if spec.at is not None and n not in spec.at:
+                    continue
+                if spec.where is not None and not all(
+                        ctx.get(k) == v for k, v in spec.where.items()):
+                    continue
+                if spec.p > 0.0 and self._rngs[i].random() >= spec.p:
+                    continue
+                self._spec_fires[i] += 1
+                self.fired.append((site, n, spec.kind, dict(ctx)))
+                bump("faults_injected")
+                _journal.record("fault_injected", site=site, occurrence=n,
+                                kind=spec.kind, **ctx)
+                return spec
+        return None
+
+    def fire(self, site: str, **ctx) -> None:
+        """Count one occurrence; act on a match: ``error`` raises
+        :class:`InjectedFault`, ``stall`` sleeps ``stall_s`` (the hang the
+        watchdog must catch), ``torn`` raises (call sites that can leave a
+        torn artifact behind use :meth:`decision` instead)."""
+        spec = self.decision(site, **ctx)
+        if spec is None:
+            return
+        if spec.kind == "stall":
+            time.sleep(spec.stall_s)
+            return
+        raise InjectedFault(
+            spec.message or f"injected {spec.kind} fault at {site} "
+            f"(occurrence {self.counts[site]}, ctx {ctx})")
+
+
+# ------------------------------------------------------------- active injector
+
+_active: Optional[FaultInjector] = None
+
+
+def set_active(inj: Optional[FaultInjector]) -> None:
+    global _active
+    _active = inj
+
+
+def get_active() -> Optional[FaultInjector]:
+    return _active
+
+
+def resolve(arg) -> Optional[FaultInjector]:
+    """Normalize a driver's ``faults=`` argument: None consults
+    ``WF_FAULT_PLAN``; False forces off; a plan/injector passes through."""
+    if arg is False:
+        return None
+    if isinstance(arg, FaultInjector):
+        return arg
+    if isinstance(arg, FaultPlan):
+        return FaultInjector(arg)
+    if isinstance(arg, str):
+        return FaultInjector(FaultPlan.from_json(arg))
+    plan = FaultPlan.from_env()
+    return FaultInjector(plan) if plan is not None else None
+
+
+@contextlib.contextmanager
+def activate(inj: Optional[FaultInjector]):
+    """Install ``inj`` as the active injector for the block; None leaves the
+    current (possibly externally installed) injector untouched."""
+    if inj is None:
+        yield None
+        return
+    prev = get_active()
+    set_active(inj)
+    try:
+        yield inj
+    finally:
+        set_active(prev)
+
+
+def fire(site: str, **ctx) -> None:
+    """Module-level injection site: one attribute load + None check when no
+    injector is active — safe in per-batch paths."""
+    inj = _active
+    if inj is not None:
+        inj.fire(site, **ctx)
+
+
+def decision(site: str, **ctx) -> Optional[FaultSpec]:
+    inj = _active
+    if inj is not None:
+        return inj.decision(site, **ctx)
+    return None
+
+
+# --------------------------------------------------------- recovery counters
+
+_COUNTER_NAMES = ("restarts", "backoff_sleeps", "backoff_seconds",
+                  "dead_letters", "watchdog_timeouts", "faults_injected",
+                  "checkpoint_saves", "checkpoint_corrupt_skipped",
+                  "checkpoint_fallbacks")
+_counters: Dict[str, float] = {k: 0 for k in _COUNTER_NAMES}
+_counters_lock = threading.Lock()
+
+
+def bump(name: str, n: float = 1) -> None:
+    """Increment a process-wide recovery counter (surfaces in the metrics
+    registry snapshot under ``recovery`` and as
+    ``windflow_recovery_<name>_total`` in the Prometheus exposition)."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> Dict[str, float]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        for k in list(_counters):
+            _counters[k] = 0
+
+
+# ------------------------------------------------------------- step watchdog
+
+def call_with_timeout(fn, timeout: Optional[float], *, stage: str = "step",
+                      pre=None):
+    """Run ``pre()`` (the injection point — stall faults sleep there) then
+    ``fn()``, enforcing ``timeout`` seconds wall-clock when set.
+
+    With a timeout the call runs in a transient worker thread; if it does not
+    finish in time the worker is *abandoned* (flagged so it will not run ``fn``
+    after waking from a pre-step stall — a late mutation of restored state
+    would corrupt recovery) and :class:`WatchdogTimeout` is raised — the
+    supervisor treats it like any other step fault and replays. A step hung
+    *inside* the device program cannot be interrupted, only detected; the
+    abandoned thread is a daemon.
+
+    The raised :class:`WatchdogTimeout` carries the abandoned thread as
+    ``.worker``: callers that restore shared state afterwards MUST join it
+    with a grace period first (the supervisors join for ``timeout`` more
+    seconds) — a slow-but-alive step then lands its mutation BEFORE the
+    restore overwrites it, instead of racing the replay. A genuinely hung
+    step never returns from the device and so never mutates."""
+    if not timeout:
+        if pre is not None:
+            pre()
+        return fn()
+    box: dict = {}
+    abandoned = threading.Event()
+
+    def worker():
+        try:
+            if pre is not None:
+                pre()
+            if abandoned.is_set():
+                return                     # watchdog gave up: leave state alone
+            box["value"] = fn()
+        except BaseException as e:         # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"wf-watchdog-{stage}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        abandoned.set()
+        bump("watchdog_timeouts")
+        _journal.record("watchdog_timeout", stage=stage, timeout_s=timeout)
+        err = WatchdogTimeout(
+            f"{stage} exceeded the {timeout}s watchdog timeout")
+        err.worker = t
+        raise err
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def join_abandoned_worker(exc, grace: Optional[float]) -> None:
+    """Before restoring state after a :class:`WatchdogTimeout`, give the
+    abandoned worker ``grace`` seconds to finish: a transiently slow (not
+    hung) step then completes its state mutation BEFORE the restore, so the
+    replay never races a late writer. No-op for other exceptions."""
+    w = getattr(exc, "worker", None)
+    if w is not None and grace:
+        w.join(grace)
+
+
+def drain_queue_to_sentinel(q, sentinel, timeout_s: float = 30.0,
+                            poll_s: float = 0.0005) -> bool:
+    """Keep popping ``q``, discarding data items, until ``sentinel`` arrives —
+    THE failure-path protocol of the threaded drivers: a dead consumer must
+    drain its input ring so the upstream producer (blocked on a full SPSC
+    ring) can finish and send its own EOS. The producer's ``finally`` always
+    sends the sentinel, so ``timeout_s`` only bounds pathological cases
+    (a killed producer thread). Returns False on timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        ok, item = q.pop(spin=64, max_yields=0)
+        if ok:
+            if item is sentinel:
+                return True
+            continue
+        time.sleep(poll_s)
+    return False
+
+
+# ------------------------------------------------- backoff with decorrelated jitter
+
+def backoff_sleep(rng: random.Random, prev: float, base: float,
+                  cap: float, *, attempt: int = 0) -> float:
+    """One decorrelated-jitter backoff step: sleep ``min(cap, U(base,
+    3*prev))`` and return the slept duration (feed it back as ``prev``).
+    ``base <= 0`` disables (returns 0 without sleeping) — restart storms
+    against a flapping device are throttled, deterministic tests opt out."""
+    if base <= 0 or cap <= 0:
+        return 0.0
+    s = min(cap, rng.uniform(base, max(base, prev * 3.0)))
+    bump("backoff_sleeps")
+    bump("backoff_seconds", s)
+    _journal.record("backoff", sleep_s=round(s, 6), attempt=attempt)
+    time.sleep(s)
+    return s
+
+
+# ------------------------------------------------------------ dead letters
+
+class DeadLetterQueue:
+    """Quarantine target for poison batches: when supervised replay keeps
+    failing at the same committed position, the offending batch lands here
+    (host copies — bounded by ``max_entries``) and the stream moves on.
+    ``spill_path`` appends one JSON summary line per entry (ids + error, not
+    the array payload) so a long-running service keeps a durable record."""
+
+    def __init__(self, spill_path: Optional[str] = None,
+                 max_entries: int = 1024):
+        self.spill_path = spill_path
+        self.max_entries = int(max_entries)
+        self.entries: List[dict] = []
+        self.dropped = 0                   # entries evicted past max_entries
+        self._lock = threading.Lock()
+
+    def put(self, batch, *, pos, error=None, driver: str = "") -> dict:
+        import numpy as np
+        entry = {"pos": pos, "driver": driver, "wall": time.time(),
+                 "error": (f"{type(error).__name__}: {error}"[:500]
+                           if error is not None else None)}
+        if batch is not None:
+            try:
+                import jax
+                host = jax.tree.map(np.asarray, batch)
+                v = np.asarray(host.valid)
+                entry["n_valid"] = int(v.sum())
+                entry["ids"] = np.asarray(host.id)[v][:32].tolist()
+                entry["batch"] = host
+            except Exception:              # noqa: BLE001 — never lose the record
+                entry["n_valid"] = None
+        with self._lock:
+            self.entries.append(entry)
+            if len(self.entries) > self.max_entries:
+                self.entries.pop(0)
+                self.dropped += 1
+            if self.spill_path:
+                summary = {k: v for k, v in entry.items() if k != "batch"}
+                with open(self.spill_path, "a") as f:
+                    f.write(json.dumps(summary, default=str) + "\n")
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.entries)

@@ -314,14 +314,18 @@ class PipeGraph:
                 self._monitor.start()
 
     def run_supervised(self, *, checkpoint_every: int = 8,
-                       max_restarts: int = 3):
+                       max_restarts: int = 3, **hardening):
         """Supervised execution of the whole DAG: aligned checkpoints, replay
         from the committed positions on failure, exactly-once delivery on every
         sink (``runtime/supervisor.py::run_graph_supervised``; the reference's
-        failure model is exit(EXIT_FAILURE), SURVEY §5)."""
+        failure model is exit(EXIT_FAILURE), SURVEY §5). ``hardening`` forwards
+        the recovery knobs: ``backoff_base``/``backoff_cap`` (decorrelated-
+        jitter restart backoff), ``dead_letter``/``poison_threshold``
+        (poison-batch quarantine), ``step_timeout`` (hung-step watchdog),
+        ``faults`` (a FaultPlan/FaultInjector for chaos testing)."""
         from .supervisor import run_graph_supervised
         return run_graph_supervised(self, checkpoint_every=checkpoint_every,
-                                    max_restarts=max_restarts)
+                                    max_restarts=max_restarts, **hardening)
 
     # -- threaded driver --------------------------------------------------------------
 
@@ -394,8 +398,8 @@ class PipeGraph:
                 chain = mp._compile(item.capacity)
                 deliver(mp, chain.push(item))
 
+            live = list(in_queues[id(mp)])
             try:
-                live = list(in_queues[id(mp)])
                 while live:
                     for q in list(live):
                         ok, item = q.pop(spin=64, max_yields=0)
@@ -427,6 +431,13 @@ class PipeGraph:
                     mp.sink.consume(None)
             except BaseException as e:          # noqa: BLE001 — re-raised at join
                 errors.append(e)
+                # drain the remaining input rings to EOS so upstream producers
+                # blocked on a full ring behind this dead pipe can finish and
+                # send their own EOS (otherwise the join above deadlocks)
+                from . import faults as _faults
+                for q in list(live):
+                    if _faults.drain_queue_to_sentinel(q, EOS):
+                        live.remove(q)
             finally:
                 propagate_eos(mp)
 

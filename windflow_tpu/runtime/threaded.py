@@ -13,20 +13,49 @@ nodes (``wf/map_gpu_node.hpp:224-340``) generalized to the whole pipeline.
 
 Thread pinning mirrors the reference default mapping (one core per stage,
 disable like NO_DEFAULT_MAPPING with ``pin=False``).
+
+Failure hardening (chaos-harness findings):
+
+- a failing stage **drains its input ring to EOS** before exiting, so an
+  upstream producer can never block forever on a full ring behind a dead
+  consumer (the deadlock the seed code had);
+- ``run()`` closes source/ops/sink even when a stage failed, then re-raises
+  the first stage error;
+- ``heartbeat_timeout`` starts a watchdog thread over per-stage heartbeats: a
+  stage that stops beating (hung device step, stalled queue) is journaled as
+  ``watchdog_stale`` and counted — a hang becomes a detectable fault instead
+  of a silent wedge. Detection only: the threaded driver has no replay
+  machinery, supervision lives in ``SupervisedPipeline``. Attribution caveat:
+  a stage blocked *pushing* into a full ring behind the stalled stage also
+  stops beating, so ``watchdog_stale`` lists the whole blocked chain — the
+  root cause is the furthest-downstream stale stage.
+
+Fault-injection sites (``runtime/faults.py``): ``source.next`` per source
+batch, ``queue.stall`` per popped item (stall kind = the latency fault the
+watchdog must notice), ``chain.step`` per segment push, ``sink.consume`` per
+sink delivery.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Sequence
 
 from ..basic import DEFAULT_BATCH_SIZE
 from ..native import SPSCQueue, pin_thread
+from ..observability import journal as _journal
 from ..operators.sink import Sink
 from ..operators.source import SourceBase
+from . import faults as _faults
 from .pipeline import CompiledChain
 
 _EOS = object()
+
+#: how long a failed stage keeps draining its input waiting for the upstream
+#: EOS marker before giving up (the upstream's ``finally`` always sends one,
+#: so this only bounds pathological cases like a killed producer thread)
+_DRAIN_TIMEOUT_S = 30.0
 
 
 class ThreadedPipeline:
@@ -35,11 +64,14 @@ class ThreadedPipeline:
     def __init__(self, source: SourceBase, segments: Sequence[Sequence],
                  sink: Optional[Sink] = None, *,
                  batch_size: int = DEFAULT_BATCH_SIZE,
-                 queue_capacity: int = 8, pin: bool = True):
+                 queue_capacity: int = 8, pin: bool = True,
+                 heartbeat_timeout: Optional[float] = None, faults=None):
         self.source = source
         self.sink = sink
         self.batch_size = batch_size
         self.pin = pin
+        self.heartbeat_timeout = heartbeat_timeout
+        self._faults_arg = faults
         spec = source.payload_spec()
         self.chains: List[CompiledChain] = []
         cap = getattr(source, "out_capacity", lambda b: b)(batch_size)
@@ -52,6 +84,19 @@ class ThreadedPipeline:
         # queue i feeds chain i; last queue feeds the sink thread
         self.queues = [SPSCQueue(queue_capacity) for _ in range(len(self.chains) + 1)]
         self._errors: List[BaseException] = []
+        self._beats = {}                    # stage name -> last heartbeat (monotonic)
+        self._done = set()                  # stages that exited
+        self.watchdog_stale: List[str] = [] # stages the watchdog flagged
+
+    # -- failure path -----------------------------------------------------------------
+
+    def _drain_to_eos(self, q) -> bool:
+        """A failed consumer keeps popping its input until the upstream's EOS
+        marker arrives — the upstream producer is blocked on a full ring
+        otherwise (SPSC ``push`` spins until space) and would never reach its
+        own EOS/exit. Returns False only on drain timeout."""
+        return _faults.drain_queue_to_sentinel(q, _EOS,
+                                               timeout_s=_DRAIN_TIMEOUT_S)
 
     # -- stage bodies -----------------------------------------------------------------
 
@@ -59,55 +104,107 @@ class ThreadedPipeline:
         if self.pin:
             pin_thread(core)
         from .pipeline import record_source_launch
+        stage = "source"
+        self._beats[stage] = time.monotonic()
         try:
+            n = 0
             for batch in self.source.batches(self.batch_size):
+                self._beats[stage] = time.monotonic()
+                _faults.fire("source.next", stage=stage, pos=n)
                 record_source_launch(self.source, batch)
                 self.queues[0].push(batch)
+                n += 1
         except BaseException as e:          # noqa: BLE001 — propagated to join
             self._errors.append(e)
         finally:
+            self._done.add(stage)
             self.queues[0].push(_EOS)
 
     def _segment_body(self, i: int, core: int):
         if self.pin:
             pin_thread(core)
         chain, q_in, q_out = self.chains[i], self.queues[i], self.queues[i + 1]
+        stage = f"seg{i}"
+        self._beats[stage] = time.monotonic()
+        eos_seen = False
         try:
+            n = 0
             while True:
-                ok, item = q_in.pop()
+                self._beats[stage] = time.monotonic()
+                ok, item = q_in.pop(spin=256, max_yields=1024)
                 if not ok:
                     continue
                 if item is _EOS:
+                    eos_seen = True
                     for out in chain.flush():
                         q_out.push(out)
                     break
+                _faults.fire("queue.stall", stage=stage, pos=n)
+                _faults.fire("chain.step", stage=stage, pos=n)
                 q_out.push(chain.push(item))
+                n += 1
         except BaseException as e:          # noqa: BLE001
             self._errors.append(e)
+            if not eos_seen:
+                self._drain_to_eos(q_in)    # unwedge the upstream producer
         finally:
+            self._done.add(stage)
             q_out.push(_EOS)
 
     def _sink_body(self, core: int):
         if self.pin:
             pin_thread(core)
         q = self.queues[-1]
+        stage = "sink"
+        self._beats[stage] = time.monotonic()
+        eos_seen = False
         try:
+            n = 0
             while True:
-                ok, item = q.pop()
+                self._beats[stage] = time.monotonic()
+                ok, item = q.pop(spin=256, max_yields=1024)
                 if not ok:
                     continue
                 if item is _EOS:
+                    eos_seen = True
                     break
+                _faults.fire("sink.consume", stage=stage, pos=n)
                 if self.sink is not None:
                     self.sink.consume(item)
+                n += 1
             if self.sink is not None:
                 self.sink.consume(None)
         except BaseException as e:          # noqa: BLE001
             self._errors.append(e)
+            if not eos_seen:
+                self._drain_to_eos(q)       # unwedge the upstream producer
+        finally:
+            self._done.add(stage)
+
+    # -- watchdog ---------------------------------------------------------------------
+
+    def _watchdog_body(self, stop: threading.Event):
+        t = self.heartbeat_timeout
+        while not stop.wait(min(t / 4.0, 0.05)):
+            now = time.monotonic()
+            for stage, last in list(self._beats.items()):
+                if stage in self._done or stage in self.watchdog_stale:
+                    continue
+                if now - last > t:
+                    self.watchdog_stale.append(stage)
+                    _faults.bump("watchdog_timeouts")
+                    _journal.record("watchdog_stale", stage=stage,
+                                    stalled_s=round(now - last, 3),
+                                    timeout_s=t)
 
     # -- run --------------------------------------------------------------------------
 
     def run(self):
+        injector = _faults.resolve(self._faults_arg)
+        with _faults.activate(injector):
+            return self._run()
+
+    def _run(self):
         threads = [threading.Thread(target=self._source_body, args=(0,),
                                     name="wf-source")]
         for i in range(len(self.chains)):
@@ -116,18 +213,41 @@ class ThreadedPipeline:
         threads.append(threading.Thread(target=self._sink_body,
                                         args=(len(self.chains) + 1,),
                                         name="wf-sink"))
+        stop_watchdog = threading.Event()
+        watchdog = None
+        if self.heartbeat_timeout:
+            watchdog = threading.Thread(target=self._watchdog_body,
+                                        args=(stop_watchdog,), daemon=True,
+                                        name="wf-watchdog")
+            watchdog.start()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        if self._errors:
-            raise self._errors[0]
+        if watchdog is not None:
+            stop_watchdog.set()
+            watchdog.join()
+        err = self._errors[0] if self._errors else None
+        # close EVERYTHING before re-raising (closing_func / svc_end parity
+        # must run on the failure path too — the seed skipped close entirely
+        # when a stage had failed); a close error surfaces only on clean runs
         for c in self.chains:
             for op in c.ops:
-                op.close()            # closing_func per replica (svc_end parity)
-        self.source.close()
+                try:
+                    op.close()
+                except Exception as ce:     # noqa: BLE001
+                    err = err or ce
+        try:
+            self.source.close()
+        except Exception as ce:             # noqa: BLE001
+            err = err or ce
         if self.sink is not None:
-            self.sink.close()
+            try:
+                self.sink.close()
+            except Exception as ce:         # noqa: BLE001
+                err = err or ce
+        if err is not None:
+            raise err
         res = {}
         for c in self.chains:
             res.update(c.result())
