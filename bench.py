@@ -454,6 +454,46 @@ def bench_latency_curve(batches=(4096, 16384, 65536, 262144), steps: int = 80,
     return out_rows
 
 
+def bench_adaptive(total_batches: int = 240, base_batch: int = None):
+    """Closed-loop capacity autotuning through the real Pipeline driver: a
+    stateless map+filter chain starts at ``base_batch`` and the control
+    plane's hill-climber converges on the ladder rung this device actually
+    sustains best; the winning plan persists to ``bench_captures/tuning.json``
+    so the next run (and any supervised run of the same chain) warm-starts
+    there. Returns end-to-end tuples/s, the chosen capacity, and the
+    controller's own per-rung rate table — the closed-loop convergence
+    evidence, next to the fixed-ladder sweep for the same shapes."""
+    import jax.numpy as jnp
+    import windflow_tpu as wf
+    from windflow_tpu import control as wfcontrol
+    from windflow_tpu.operators.source import DeviceSource
+
+    base = base_batch or max(BATCH // 4, 1 << 12)
+    cache_path = os.path.join(os.path.dirname(CAPTURE_PATH), "tuning.json")
+    cfg = wf.ControlConfig(autotune=True, ladder_up=2, ladder_down=2,
+                           decide_every=6, settle_batches=2,
+                           cache_path=cache_path)
+    src = DeviceSource(lambda i: {"v": (i % 1000).astype(jnp.float32)},
+                       total=total_batches * base, num_keys=512)
+    pipe = wf.Pipeline(src, [wf.Map(lambda t: {"v": t.v * 2.0 + 1.0}),
+                             wf.Filter(lambda t: t.v > 100.0),
+                             wf.ReduceSink(lambda t: t.v)],
+                       batch_size=base, control=cfg)
+    t0 = time.perf_counter()
+    pipe.run()
+    dt = time.perf_counter() - t0
+    ctl = wfcontrol.counters()
+    return {
+        "tps": total_batches * base / dt,
+        "base_capacity": base,
+        "chosen_capacity": wfcontrol.gauges().get("chosen_capacity"),
+        "capacity_switches": ctl["capacity_switches"],
+        "tuning_decisions": ctl["tuning_decisions"],
+        "cache_path": cache_path,
+        "metrics": _chain_metrics(pipe.chain),
+    }
+
+
 def bench_keyed_stateful(num_keys: int):
     """MapGPU-stateful analogue (BASELINE.md rows 3-5): keyed map with a per-key
     running state folded in stream order (the reference keeps a per-key device
@@ -1062,6 +1102,14 @@ def _secondary_benches(ysb_tps, ysb_step_s):
             print(f"keyed-stateful map (K={k}): {ks_tps/1e6:.2f} M tuples/s "
                   f"({ks_step*1e3:.2f} ms/step)  [CUDA bar: 0.44-0.64M @1, "
                   f"11.8M @500, 10M @10k]", file=sys.stderr)
+        ad = _run_isolated("bench_adaptive()")
+        record("adaptive", ad, methodology="isolated-subprocess")
+        print(f"adaptive capacity autotune: {ad['tps']/1e6:.2f} M tuples/s, "
+              f"base {ad['base_capacity']} -> chosen "
+              f"{ad['chosen_capacity']} "
+              f"({ad['capacity_switches']} switches, "
+              f"{ad['tuning_decisions']} decisions; plan cached at "
+              f"{ad['cache_path']})", file=sys.stderr)
         wm_tps, wm_step, wm_roof, wm_metrics = _run_isolated("bench_ysb_wmr()")
         record("ysb_wmr", {"tps": wm_tps, "step_s": wm_step,
                            "roofline": wm_roof, "metrics": wm_metrics},

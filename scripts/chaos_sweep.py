@@ -8,7 +8,15 @@ threaded driver) is injected via runtime/faults.py; the run's outputs must be
 byte-identical to the fault-free oracle (exactly-once under injection).
 Exit code 0 = no divergence, 1 = at least one.
 
+--controller additionally runs every driver with the adaptive control plane
+active (deterministic positional admission on the supervised drivers — shed
+decisions are part of the replayed stream, so faulted runs must still match
+the fault-free controlled baseline byte-for-byte; backpressure governor on
+the threaded driver). Controller + injection must neither diverge nor
+livelock the supervisor's backoff.
+
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --total 400
+    JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --controller
 """
 
 import argparse
@@ -32,6 +40,19 @@ from windflow_tpu.runtime.faults import (FaultInjector,   # noqa: E402
 from windflow_tpu.runtime.pipegraph import PipeGraph      # noqa: E402
 from windflow_tpu.runtime.supervisor import SupervisedPipeline  # noqa: E402
 from windflow_tpu.runtime.threaded import ThreadedPipeline      # noqa: E402
+from windflow_tpu.control import ControlConfig                  # noqa: E402
+
+
+def sup_control(batch):
+    # deterministic positional bucket: ~80% admitted, replay-stable
+    return ControlConfig(autotune=False, backpressure=False, admission=True,
+                         refill_per_batch=0.8 * batch, burst_tuples=2 * batch)
+
+
+def thr_control():
+    # governor only: throttling delays, never drops — results must not change
+    return ControlConfig(autotune=False, backpressure=True,
+                         high_watermark=0.5, low_watermark=0.25)
 
 
 def collect(acc):
@@ -43,7 +64,7 @@ def collect(acc):
     return cb
 
 
-def run_pipeline(total, batch, faults=None):
+def run_pipeline(total, batch, faults=None, controller=False):
     got = []
     src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
                     total=total, num_keys=4)
@@ -52,11 +73,13 @@ def run_pipeline(total, batch, faults=None):
     SupervisedPipeline(src, [op], wf.Sink(collect(got)), batch_size=batch,
                        checkpoint_every=3, max_restarts=8,
                        backoff_base=0.001, backoff_cap=0.01,
-                       faults=faults).run()
+                       faults=faults,
+                       control=sup_control(batch) if controller else False
+                       ).run()
     return sorted(got)
 
 
-def run_graph(total, batch, faults=None):
+def run_graph(total, batch, faults=None, controller=False):
     got = []
     g = PipeGraph("sweep", batch_size=batch)
     a = g.add_source(wf.Source(lambda i: {"v": (i % 9).astype(jnp.float32)},
@@ -68,11 +91,12 @@ def run_graph(total, batch, faults=None):
                      WindowSpec(12, 12, win_type_t.CB), num_keys=3))
      .add_sink(wf.Sink(collect(got))))
     g.run_supervised(checkpoint_every=3, max_restarts=8,
-                     backoff_base=0.001, backoff_cap=0.01, faults=faults)
+                     backoff_base=0.001, backoff_cap=0.01, faults=faults,
+                     control=sup_control(batch) if controller else False)
     return sorted(got)
 
 
-def run_threaded(total, batch, faults=None):
+def run_threaded(total, batch, faults=None, controller=False):
     got = []
     src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=total)
     ThreadedPipeline(src, [[wf.Map(lambda t: {"v": t.v * 3})],
@@ -82,7 +106,8 @@ def run_threaded(total, batch, faults=None):
                              np.asarray(v["payload"]["v"]).tolist()))
                          if v is not None else None),
                      batch_size=batch, pin=False, heartbeat_timeout=0.25,
-                     faults=faults).run()
+                     faults=faults,
+                     control=thr_control() if controller else False).run()
     return sorted(got)
 
 
@@ -102,6 +127,10 @@ def main():
     ap.add_argument("--seeds", type=int, default=5)
     ap.add_argument("--total", type=int, default=400)
     ap.add_argument("--batch", type=int, default=40)
+    ap.add_argument("--controller", action="store_true",
+                    help="run every driver with the adaptive control plane "
+                    "active (admission/backpressure; baselines use the same "
+                    "controller, so shedding must stay deterministic)")
     args = ap.parse_args()
 
     drivers = {"pipeline": run_pipeline, "graph": run_graph,
@@ -109,7 +138,8 @@ def main():
     baselines = {}
     for name, fn in drivers.items():
         t0 = time.time()
-        baselines[name] = fn(args.total, args.batch)
+        baselines[name] = fn(args.total, args.batch,
+                             controller=args.controller)
         print(f"[baseline] {name}: {len(baselines[name])} results "
               f"({time.time() - t0:.1f}s)")
 
@@ -119,7 +149,8 @@ def main():
             inj = FaultInjector(plan_for(seed, threaded=(name == "threaded")))
             t0 = time.time()
             try:
-                out = fn(args.total, args.batch, faults=inj)
+                out = fn(args.total, args.batch, faults=inj,
+                         controller=args.controller)
             except Exception as e:          # noqa: BLE001
                 print(f"[seed {seed}] {name}: RUN FAILED {type(e).__name__}: "
                       f"{e} ({len(inj.fired)} faults injected)")

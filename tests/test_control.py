@@ -1,0 +1,558 @@
+"""Adaptive control plane (windflow_tpu/control/): deterministic fake-clock
+controller-decision tests, the controller-on/off byte-identity regression on
+mp-matrix workloads, the synthetic-overload bounded-backlog demonstration,
+and the controller x fault-injection chaos interaction."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.batch import Batch, concat_batches, split_batch
+from windflow_tpu.control import (AdmissionController, BackpressureGovernor,
+                                  CapacityAutotuner, ControlConfig,
+                                  PositionBucket, Rebatcher, TokenBucket,
+                                  TuningCache, build_ladder)
+from windflow_tpu.control import _state as control_state
+from windflow_tpu.observability import MetricsRegistry
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.operators.win_patterns import Key_FFAT, Pane_Farm
+from windflow_tpu.operators.win_seq import Win_Seq
+from windflow_tpu.runtime.faults import FaultInjector, FaultPlan, FaultSpec
+from windflow_tpu.runtime.threaded import ThreadedPipeline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    control_state.reset()
+    yield
+    control_state.reset()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _mkbatch(n, start=0, ts=None):
+    i = np.arange(start, start + n, dtype=np.int32)
+    return Batch(key=jnp.asarray(i % 4), id=jnp.asarray(i),
+                 ts=jnp.asarray(ts if ts is not None else i),
+                 payload={"v": jnp.asarray(i, jnp.float32)},
+                 valid=jnp.ones(n, bool))
+
+
+# ---------------------------------------------------------------- primitives
+
+def test_split_concat_roundtrip():
+    b = _mkbatch(32)
+    parts = split_batch(b, 8)
+    assert len(parts) == 4 and all(p.capacity == 8 for p in parts)
+    back = parts[0]
+    for p in parts[1:]:
+        back = concat_batches(back, p)
+    for leaf_a, leaf_b in zip(jax.tree.leaves(b), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
+    with pytest.raises(ValueError):
+        split_batch(b, 5)                    # 5 does not divide 32
+
+
+def test_build_ladder_divisibility_and_bounds():
+    assert build_ladder(64, up=2, down=2) == [16, 32, 64, 128, 256]
+    # odd base: no down rungs (cannot slice exactly)
+    assert build_ladder(40, up=1, down=3) == [10, 20, 40, 80]
+    assert build_ladder(24, up=0, down=5, min_capacity=8) == [12, 24]
+    assert 7 not in build_ladder(7, up=0, down=3)[:-1]
+
+
+def test_rebatcher_up_down_and_drain():
+    rb = Rebatcher(8)
+    b0, b1, b2 = _mkbatch(8), _mkbatch(8, 8), _mkbatch(8, 16)
+    assert rb.feed(b0) == [b0]               # target == base: passthrough
+    rb.set_target(16)
+    assert rb.feed(b1) == []                 # buffering toward 16
+    out = rb.feed(b2)
+    assert len(out) == 1 and out[0].capacity == 16
+    np.testing.assert_array_equal(np.asarray(out[0].id), np.arange(8, 24))
+    rb.set_target(4)
+    out = rb.feed(_mkbatch(8, 24))
+    assert [o.capacity for o in out] == [4, 4]
+    rb.set_target(16)
+    assert rb.feed(_mkbatch(8, 32)) == []
+    tail = rb.drain()                        # EOS: partial buffer at base cap
+    assert len(tail) == 1 and tail[0].capacity == 8
+    with pytest.raises(ValueError):
+        rb.set_target(12)                    # neither multiple nor divisor
+
+
+# ------------------------------------------------------- admission (fake clock)
+
+def test_token_bucket_fake_clock_shed_pattern():
+    clk = FakeClock()
+    adm = AdmissionController(TokenBucket(rate=10.0, burst=20.0, clock=clk),
+                              "drop_newest")
+    b = _mkbatch(10)
+    decisions = []
+    for _ in range(6):
+        decisions.append(bool(adm.offer(b)))
+        clk.advance(0.5)                     # +5 tokens per offer
+    # burst 20: admit (10 left), +5 admit (5), +5 admit (0), +5 shed,
+    # +5 admit (0), +5 shed — the exact refill arithmetic, no timing slack
+    assert decisions == [True, True, True, False, True, False]
+    assert adm.shed == 2 and adm.admitted == 4
+    c = control_state.counters()
+    assert c["shed_batches"] == 2 and c["shed_tuples"] == 20
+    assert c["admitted_batches"] == 4
+
+
+def test_position_bucket_is_deterministic():
+    def pattern():
+        adm = AdmissionController(PositionBucket(refill_per_batch=6, burst=10),
+                                  "drop_newest")
+        return [bool(adm.offer(_mkbatch(10))) for _ in range(8)]
+    assert pattern() == pattern()
+    assert pattern().count(False) > 0        # it does shed at this rate
+
+
+def test_drop_oldest_ts_sheds_stale_holds_fresh():
+    clk = FakeClock()
+    adm = AdmissionController(TokenBucket(rate=0.0, burst=10.0, clock=clk),
+                              "drop_oldest_ts", hold_max=2)
+    b0, b1, b2, b3 = (_mkbatch(10, 100 * k) for k in range(4))
+    assert adm.offer(b0) == [b0]             # burst covers the first
+    assert adm.offer(b1) == []               # held
+    assert adm.offer(b2) == []               # held (2 = hold_max)
+    assert adm.offer(b3) == []               # overflow: b1 (oldest ts) shed
+    assert adm.shed == 1
+    held_ids = [int(np.asarray(b.id)[0]) for b, _ in adm.held]
+    assert held_ids == [200, 300]            # stale dropped, fresh kept
+    drained = adm.drain()                    # EOS admits the bounded tail
+    assert [int(np.asarray(b.id)[0]) for b in drained] == [200, 300]
+
+
+def test_admission_state_roundtrip():
+    adm = AdmissionController(PositionBucket(4, 12), "drop_newest")
+    for k in range(5):
+        adm.offer(_mkbatch(8, 8 * k))
+    st = adm.state()
+    adm2 = AdmissionController(PositionBucket(4, 12), "drop_newest")
+    adm2.set_state(st)
+    a = [bool(adm.offer(_mkbatch(8, 99))) for _ in range(6)]
+    b = [bool(adm2.offer(_mkbatch(8, 99))) for _ in range(6)]
+    assert a == b                            # replayed decisions identical
+
+
+# ------------------------------------------------------ autotuner (fake clock)
+
+RATES = {16: 1000.0, 32: 3000.0, 64: 5000.0, 128: 9000.0, 256: 7000.0}
+
+
+def _drive_tuner(tuner, clk, rates, max_batches=500):
+    """Feed on_batch with a synthetic per-rung service rate until converged."""
+    for _ in range(max_batches):
+        cap = tuner.capacity
+        clk.advance(cap / rates[cap])        # one batch takes cap/rate secs
+        tuner.on_batch(cap)
+        if tuner.converged:
+            return
+    raise AssertionError("tuner did not converge")
+
+
+def test_hill_climb_converges_to_best_rung(tmp_path):
+    clk = FakeClock()
+    cache = TuningCache(str(tmp_path / "tune.json"))
+    tuner = CapacityAutotuner(sorted(RATES), start_capacity=64,
+                              decide_every=4, settle_batches=1,
+                              clock=clk, cache=cache, cache_key="k1")
+    _drive_tuner(tuner, clk, RATES)
+    assert tuner.capacity == 128             # the synthetic optimum
+    best_rate = max(tuner.plan()["rates"].values())
+    # the acceptance bound: converged rung within 10% of the best measured
+    assert tuner.plan()["rates"][tuner.capacity] >= 0.9 * best_rate
+    saved = json.load(open(cache.path))["k1"]
+    assert saved["capacity"] == 128
+
+
+def test_cache_warm_start_begins_at_optimum(tmp_path):
+    cache = TuningCache(str(tmp_path / "tune.json"))
+    cache.put("k1", {"capacity": 128, "tps": 9000.0})
+    tuner = CapacityAutotuner(sorted(RATES), start_capacity=64,
+                              cache=cache, cache_key="k1")
+    # warm start: already converged AT the cached rung, zero exploration
+    assert tuner.converged and tuner.capacity == 128
+    assert tuner.on_batch(128) is None
+    assert control_state.counters()["tuning_cache_hits"] == 1
+
+
+def test_tuner_never_retraces_unknown_rungs():
+    clk = FakeClock()
+    tuner = CapacityAutotuner([32, 64, 128], start_capacity=32,
+                              decide_every=2, settle_batches=0, clock=clk)
+    seen = set()
+    for _ in range(200):
+        seen.add(tuner.capacity)
+        clk.advance(1.0)
+        tuner.on_batch(tuner.capacity)
+        if tuner.converged:
+            break
+    assert seen <= {32, 64, 128}             # only ladder rungs ever actuated
+
+
+# ------------------------------------------------------------------ governor
+
+def test_governor_throttles_until_low_watermark():
+    gov = BackpressureGovernor(high_watermark=0.5, low_watermark=0.25,
+                               poll_s=0.001)
+    depth = [8]
+    gov.watch("edge", lambda: depth[0], capacity=8)   # hi=4, lo=2
+    released = []
+
+    def drainer():
+        time.sleep(0.05)
+        depth[0] = 2                         # drain to the low watermark
+        released.append(gov.pause_event.is_set())
+
+    t = threading.Thread(target=drainer)
+    t.start()
+    waited = gov.throttle()
+    t.join()
+    assert waited > 0 and gov.throttles == 1
+    assert released == [True]                # pause hook was set while waiting
+    assert not gov.pause_event.is_set()      # and cleared after release
+    assert gov.throttle() == 0.0             # below hi: fast path
+    c = control_state.counters()
+    assert c["throttle_events"] == 1 and c["throttle_seconds"] > 0
+
+
+def test_governor_stop_unblocks():
+    gov = BackpressureGovernor(high_watermark=0.5, low_watermark=0.25)
+    gov.watch("edge", lambda: 8, capacity=8)  # permanently over-high
+    t = threading.Thread(target=gov.throttle)
+    t.start()
+    time.sleep(0.02)
+    gov.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_prefetch_pause_event_suspends_worker():
+    pulled = [0]
+
+    def it():
+        for s in range(20):
+            pulled[0] += 1
+            yield {"v": np.full(4, s, np.float32)}
+
+    from windflow_tpu.operators.source import GeneratorSource
+    src = GeneratorSource(it, {"v": jax.ShapeDtypeStruct((), jnp.float32)})
+    pause = threading.Event()
+    pause.set()
+    batches = src.batches_prefetched(4, depth=1, pause_event=pause)
+    time.sleep(0.1)
+    assert pulled[0] <= 1                    # paused before pulling ahead
+    pause.clear()
+    assert len(list(batches)) == 20 and pulled[0] == 20
+
+
+# --------------------------------------------------- config / env resolution
+
+def test_wf_control_env_resolution(monkeypatch):
+    monkeypatch.delenv("WF_CONTROL", raising=False)
+    assert ControlConfig.resolve(None) is None          # off by default
+    assert ControlConfig.resolve(False) is None
+    monkeypatch.setenv("WF_CONTROL", "0")
+    assert ControlConfig.resolve(None) is None
+    monkeypatch.setenv("WF_CONTROL", "1")
+    assert ControlConfig.resolve(None) is not None
+    monkeypatch.setenv("WF_CONTROL",
+                       '{"admission": true, "rate_tps": 123.0, '
+                       '"shed_policy": "drop_oldest_ts"}')
+    cfg = ControlConfig.resolve(None)
+    assert cfg.rate_tps == 123.0 and cfg.shed_policy == "drop_oldest_ts"
+    with pytest.raises(ValueError):
+        ControlConfig(shed_policy="nope")
+    with pytest.raises(ValueError):
+        ControlConfig(high_watermark=0.2, low_watermark=0.5)
+
+
+def test_per_edge_queue_capacities_and_exposure():
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=64)
+    tp = ThreadedPipeline(
+        src, [[wf.Map(lambda t: {"v": t.v})], [wf.Map(lambda t: {"v": t.v})]],
+        wf.Sink(lambda v: None), batch_size=16, pin=False,
+        queue_capacity={"src->seg0": 2, "seg1->sink": 32})
+    assert tp.edge_names == ["src->seg0", "seg0->seg1", "seg1->sink"]
+    assert tp.edge_capacities == {"src->seg0": 2, "seg0->seg1": 8,
+                                  "seg1->sink": 32}
+    assert set(tp.queue_depths()) == set(tp.edge_names)
+    # callable form + registry exposure of capacity alongside depth
+    tp2 = ThreadedPipeline(
+        src, [[wf.Map(lambda t: {"v": t.v})]], None, batch_size=16, pin=False,
+        queue_capacity=lambda name, i: 4 + i)
+    assert tp2.edge_capacities == {"src->seg0": 4, "seg0->sink": 5}
+    reg = MetricsRegistry("t")
+    for name, q in zip(tp2.edge_names, tp2.queues):
+        reg.attach_queue_gauge(name, q.size,
+                               capacity=tp2.edge_capacities[name])
+    snap = reg.snapshot()
+    assert snap["queue_capacity"] == tp2.edge_capacities
+    assert "windflow_queue_capacity" in reg.to_prometheus(snap)
+
+
+# ------------------------------------------- regression: byte-identical on/off
+
+TOTAL, K = 240, 3
+
+MP_CASES = {
+    "win_seq_tb": lambda: [Win_Seq(lambda wid, it: it.sum("v"),
+                                   WindowSpec(12, 6, win_type_t.TB),
+                                   num_keys=K)],
+    "key_ffat_cb": lambda: [Key_FFAT(lambda t: t.v, jnp.add,
+                                     spec=WindowSpec(8, 2, win_type_t.CB),
+                                     num_keys=K)],
+    # Pane_Farm compiles two Win_Seq engines per ladder rung — the heaviest
+    # case rides the slow tier; the two above keep the gather + FFAT engines
+    # in tier-1
+    "pf_chained": lambda: [wf.Map(lambda t: {"v": t.v * 2.0}),
+                           Pane_Farm(lambda pid, it: it.sum("v"),
+                                     lambda wid, it: it.sum(),
+                                     WindowSpec(9, 3, win_type_t.CB),
+                                     num_keys=K)],
+}
+
+MP_PARAMS = [pytest.param(c, marks=pytest.mark.slow) if c == "pf_chained"
+             else c for c in sorted(MP_CASES)]
+
+
+def _run_mp_case(make_ops, control):
+    src = wf.Source(lambda i: {"v": ((i * 13) % 23).astype(jnp.float32)},
+                    total=TOTAL, num_keys=K)
+    results = []
+
+    def cb(view):
+        if view is None:
+            return
+        for k, w, r in zip(view["key"].tolist(), view["id"].tolist(),
+                           np.asarray(view["payload"]).tolist()):
+            results.append((k, w, round(float(r), 3)))
+
+    wf.Pipeline(src, make_ops(), wf.Sink(cb), batch_size=16,
+                control=control).run()
+    return sorted(results)
+
+
+@pytest.mark.parametrize("case", MP_PARAMS)
+def test_controller_on_off_byte_identical(case):
+    """The mp-matrix invariance property, under the control plane: the
+    autotuner's mid-stream rung switches (forced by a tiny decide window)
+    must not change a single result."""
+    off = _run_mp_case(MP_CASES[case], control=False)
+    on = _run_mp_case(MP_CASES[case],
+                      ControlConfig(autotune=True, decide_every=2,
+                                    settle_batches=0, admission=False,
+                                    ladder_up=1, ladder_down=0))
+    assert on == off and len(off) > 0
+    # and the controller really did actuate (otherwise this test is vacuous)
+    assert control_state.counters()["capacity_switches"] > 0
+
+
+def test_control_off_is_default_and_inert(monkeypatch):
+    monkeypatch.delenv("WF_CONTROL", raising=False)
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=64)
+    p = wf.Pipeline(src, [wf.Map(lambda t: {"v": t.v})],
+                    wf.Sink(lambda v: None), batch_size=16)
+    assert p._control is None and p._ladder is None
+    p.run()
+    c = control_state.counters()
+    assert not any(c.values())               # zero controller activity
+
+
+# ----------------------------------------- overload: bounded vs pegged backlog
+
+def _overload_run(control):
+    """Fast source, slow sink (the synthetic overload); samples ring depth."""
+    got, max_depth = [], [0]
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=50 * 32)
+    tp = ThreadedPipeline(
+        src, [[wf.Map(lambda t: {"v": t.v})]],
+        wf.Sink(lambda v: (time.sleep(0.004),
+                           got.extend(np.asarray(v["payload"]["v"]).tolist()))
+                if v is not None else None),
+        batch_size=32, pin=False, queue_capacity=8, control=control)
+    stop = threading.Event()
+
+    def watch():
+        while not stop.is_set():
+            max_depth[0] = max(max_depth[0], *tp.queue_depths().values())
+            time.sleep(0.0005)
+
+    w = threading.Thread(target=watch)
+    w.start()
+    tp.run()
+    stop.set()
+    w.join()
+    return got, max_depth[0], tp
+
+
+def test_overload_bounded_with_control_pegged_without():
+    # control ON: admission sheds + governor keeps depth below the high
+    # watermark (hi = 0.5 * 8 = 4)
+    on_cfg = ControlConfig(autotune=False, backpressure=True,
+                           high_watermark=0.5, low_watermark=0.25,
+                           admission=True, rate_tps=3000.0, burst_tuples=64.0)
+    got_on, depth_on, _tp = _overload_run(on_cfg)
+    c = control_state.counters()
+    # hi + 1: the governor admits one push after each release, and the
+    # sampling probe can race a concurrent push/pop by one slot — bounded at
+    # the watermark, not pegged at ring capacity, is the property
+    assert depth_on <= 5, f"rings exceeded the high watermark: {depth_on}"
+    assert c["shed_batches"] > 0 and c["throttle_events"] >= 0
+    assert len(got_on) < 50 * 32             # load was genuinely shed
+    # the evidence shows up in the snapshot AND the Prometheus exposition
+    reg = MetricsRegistry("overload")
+    snap = reg.snapshot()
+    assert snap["control"]["counters"]["shed_batches"] > 0
+    prom = reg.to_prometheus(snap)
+    assert "windflow_control_shed_batches_total" in prom
+    assert "windflow_control_throttle_events_total" in prom
+    # control OFF: the ring pegs at/over the watermark (implicit blocking
+    # backpressure only — the backlog signal nobody sees)
+    control_state.reset()
+    got_off, depth_off, _tp = _overload_run(False)
+    assert len(got_off) == 50 * 32           # nothing shed...
+    assert depth_off > 4                     # ...but the ring filled past hi
+    assert not any(control_state.counters().values())
+
+
+# --------------------------------------------- chaos: controller x fault plan
+
+def _sup_control(batch):
+    return ControlConfig(autotune=False, backpressure=False, admission=True,
+                         refill_per_batch=0.75 * batch,
+                         burst_tuples=2.0 * batch)
+
+
+def _run_supervised(faults=None, batch=16):
+    out = []
+    src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+                    total=TOTAL, num_keys=4)
+    op = Win_Seq(lambda wid, it: it.sum("v"),
+                 WindowSpec(10, 10, win_type_t.TB), num_keys=4)
+    wf.SupervisedPipeline(
+        src, [op],
+        wf.Sink(lambda v: v is not None and out.extend(
+            zip(v["key"].tolist(), v["id"].tolist(),
+                np.asarray(v["payload"]).round(3).tolist()))),
+        batch_size=batch, checkpoint_every=3, max_restarts=8,
+        backoff_base=0.001, backoff_cap=0.01, faults=faults,
+        control=_sup_control(batch)).run()
+    return sorted(out)
+
+
+@pytest.mark.chaos
+def test_supervised_admission_replays_shed_decisions_under_faults():
+    """Controller active under FaultPlan injection: the deterministic
+    positional bucket + snapshot/restore makes shed decisions part of the
+    replayed stream — outputs match the fault-free controlled run exactly,
+    and the run terminates (no backoff livelock)."""
+    baseline = _run_supervised()
+    t0 = time.monotonic()
+    faulted = _run_supervised(FaultInjector(FaultPlan(
+        [FaultSpec("source.next", p=0.06), FaultSpec("chain.step", p=0.10),
+         FaultSpec("sink.consume", p=0.10)], seed=11)))
+    assert faulted == baseline and len(baseline) > 0
+    assert time.monotonic() - t0 < 120       # terminated, no livelock
+    assert control_state.counters()["shed_batches"] > 0
+
+
+@pytest.mark.chaos
+def test_graph_supervised_admission_under_faults():
+    from windflow_tpu.runtime.pipegraph import PipeGraph
+
+    def run(faults=None):
+        got = []
+        g = PipeGraph("ctl", batch_size=12)
+        a = g.add_source(wf.Source(lambda i: {"v": (i % 9).astype(jnp.float32)},
+                                   total=144, num_keys=3, name="a"))
+        b = g.add_source(wf.Source(lambda i: {"v": (i % 7).astype(jnp.float32)},
+                                   total=72, num_keys=3, name="b"))
+        (a.merge(b)
+         .add(wf.Map(lambda t: {"v": t.v + 1.0}))
+         .add_sink(wf.Sink(lambda v: v is not None and got.extend(
+             zip(v["key"].tolist(), v["id"].tolist(),
+                 np.asarray(v["payload"]["v"]).tolist())))))
+        g.run_supervised(checkpoint_every=3, max_restarts=8,
+                         backoff_base=0.001, backoff_cap=0.01, faults=faults,
+                         control=_sup_control(12))
+        return sorted(got)
+
+    baseline = run()
+    faulted = run(FaultInjector(FaultPlan(
+        [FaultSpec("chain.step", p=0.08), FaultSpec("sink.consume", p=0.08)],
+        seed=5)))
+    assert faulted == baseline and len(baseline) > 0
+
+
+def test_supervised_rejects_nondeterministic_admission():
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=32)
+    with pytest.raises(ValueError, match="refill_per_batch"):
+        wf.SupervisedPipeline(
+            src, [wf.Map(lambda t: {"v": t.v})], batch_size=16,
+            control=ControlConfig(admission=True, rate_tps=100.0))
+    with pytest.raises(ValueError, match="drop_newest"):
+        wf.SupervisedPipeline(
+            src, [wf.Map(lambda t: {"v": t.v})], batch_size=16,
+            control=ControlConfig(admission=True, refill_per_batch=8.0,
+                                  shed_policy="drop_oldest_ts"))
+
+
+def test_supervised_warm_starts_from_tuning_cache(tmp_path):
+    """A plan persisted by a live Pipeline run is consumed read-only by the
+    supervised driver: same chain signature -> start at the tuned capacity."""
+    from windflow_tpu.control import (chain_signature, device_kind,
+                                      payload_signature, tuning_key)
+    cache_path = str(tmp_path / "tune.json")
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=64)
+    ops = [wf.Map(lambda t: {"v": t.v * 2.0})]
+    key = tuning_key(chain_signature(ops),
+                     payload_signature(src.payload_spec()), device_kind())
+    TuningCache(cache_path).put(key, {"capacity": 32, "tps": 1.0})
+    sp = wf.SupervisedPipeline(
+        src, ops, batch_size=16,
+        control=ControlConfig(autotune=True, cache_path=cache_path))
+    assert sp.batch_size == 32               # warm-started at the cached rung
+    assert control_state.counters()["tuning_cache_hits"] == 1
+
+
+# -------------------------------------------------- sweep: the adaptive row
+
+def test_sweep_adaptive_rows_and_warm_start(tmp_path):
+    from windflow_tpu.benchmarks.sweep import render_markdown, run_adaptive
+    cache = str(tmp_path / "tune.json")
+    rows = run_adaptive(batches=(128, 256), keyset=(4,),
+                        names=("map_stateless",), steps=2, cache_path=cache)
+    assert len(rows) == 1
+    name, cap, keys, tps = rows[0]
+    assert name.endswith("(adaptive)") and cap in (128, 256) and tps > 0
+    # second run warm-starts at the cached rung (no re-exploration)
+    control_state.reset()
+    rows2 = run_adaptive(batches=(128, 256), keyset=(4,),
+                         names=("map_stateless",), steps=2, cache_path=cache)
+    assert rows2[0][1] == cap                # same rung, straight away
+    assert control_state.counters()["tuning_cache_hits"] == 1
+    assert control_state.counters()["capacity_switches"] == 0
+    md = render_markdown(rows + rows2, "cpu-test")
+    assert "(adaptive)" in md

@@ -1,0 +1,84 @@
+"""Static-analysis gate: every ``WF_*`` environment flag read anywhere in the
+tree must be documented in ``docs/ENV_FLAGS.md`` — including *when* it is read
+(the ADVICE round-5 footgun: trace-time reads are baked into cached
+executables, so an undocumented flag toggled mid-process silently does
+nothing). A new env read without a docs row fails tier-1."""
+
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(ROOT, "docs", "ENV_FLAGS.md")
+
+#: a line is an env READ when it touches the environment (os.environ /
+#: getenv) or defines the default env-var name a reader resolves later
+#: (``var: str = "WF_FAULT_PLAN"`` in FaultPlan.from_env)
+_READ_LINE = re.compile(r"environ|getenv|var\s*:\s*str\s*=\s*\"WF_")
+_FLAG = re.compile(r"WF_[A-Z][A-Z0-9_]*")
+
+
+def _py_files():
+    scan_dirs = [os.path.join(ROOT, "windflow_tpu"),
+                 os.path.join(ROOT, "scripts")]
+    files = [os.path.join(ROOT, "bench.py")]
+    for d in scan_dirs:
+        for dirpath, _dirs, names in os.walk(d):
+            files += [os.path.join(dirpath, n) for n in names
+                      if n.endswith(".py")]
+    return files
+
+
+def _flags_read():
+    found = {}                       # flag -> first "file:line" seen
+    for path in _py_files():
+        rel = os.path.relpath(path, ROOT)
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if not _READ_LINE.search(line):
+                    continue
+                for flag in _FLAG.findall(line):
+                    found.setdefault(flag, f"{rel}:{lineno}")
+    return found
+
+
+def _documented():
+    """Parse the ENV_FLAGS.md table: {flag: read-at cell}."""
+    rows = {}
+    with open(DOC) as f:
+        for line in f:
+            m = re.match(r"\|\s*`(WF_[A-Z0-9_]+)`\s*\|([^|]*)\|", line)
+            if m:
+                rows[m.group(1)] = m.group(2).strip()
+    return rows
+
+
+def test_every_env_flag_read_is_documented():
+    read = _flags_read()
+    assert read, "the scanner found no WF_* env reads at all — it is broken"
+    docs = _documented()
+    missing = {f: where for f, where in read.items() if f not in docs}
+    assert not missing, (
+        f"WF_* env flags read in the tree but missing from docs/ENV_FLAGS.md "
+        f"(add a table row incl. the read-at column): {missing}")
+
+
+def test_every_documented_flag_states_read_time():
+    docs = _documented()
+    assert docs, "docs/ENV_FLAGS.md has no flag table rows"
+    bad = {f: cell for f, cell in docs.items()
+           if not re.search(r"trace|run time|process start|start", cell,
+                            re.I)}
+    assert not bad, (
+        f"ENV_FLAGS.md rows whose 'read at' cell does not state WHEN the "
+        f"flag is read (trace time vs run time vs process start): {bad}")
+
+
+def test_known_trace_time_flags_marked():
+    """The four flags read inside jitted code paths must carry the trace-time
+    marking — the footgun the inventory exists to prevent."""
+    docs = _documented()
+    for flag in ("WF_LOOKUP_IMPL", "WF_HISTOGRAM_IMPL",
+                 "WF_HISTOGRAM_FORCE_FAST", "WF_ORDERING_SKIP_SORTED"):
+        assert flag in docs, f"{flag} missing from ENV_FLAGS.md"
+        assert "trace" in docs[flag].lower(), (
+            f"{flag} is read at trace time but ENV_FLAGS.md does not say so")

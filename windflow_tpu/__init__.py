@@ -11,7 +11,7 @@ See SURVEY.md for the blueprint.
 from .basic import (Mode, win_type_t, opt_level_t, routing_modes_t, pattern_t,
                     win_event_t, ordering_mode_t, role_t,
                     current_time_usecs, current_time_nsecs, WinOperatorConfig)
-from .batch import Batch, TupleRef, tuple_refs, concat_batches
+from .batch import Batch, TupleRef, tuple_refs, concat_batches, split_batch
 from .context import RuntimeContext, LocalStorage
 from .shipper import Shipper
 from .operators import (Basic_Operator, Source, DeviceSource, GeneratorSource,
@@ -33,6 +33,9 @@ from .runtime.async_sink import AsyncResultShipper, ShippedResult
 from .runtime.checkpoint import save_chain, load_chain, CheckpointCorrupt
 from .runtime.faults import (FaultPlan, FaultSpec, FaultInjector,
                              InjectedFault, WatchdogTimeout, DeadLetterQueue)
+from .control import (ControlConfig, AdmissionController, TokenBucket,
+                      PositionBucket, BackpressureGovernor, CapacityAutotuner,
+                      Rebatcher, TuningCache)
 from .operators.source import prefetch_to_device
 from .parallel import make_mesh, make_mesh_2d
 from .parallel.sharding import ShardedChain, shard_batch

@@ -212,6 +212,28 @@ def concat_batches(a: Batch, b: Batch) -> Batch:
     )
 
 
+def split_batch(batch: Batch, capacity: int) -> list:
+    """Slice a batch into ``capacity``-sized pieces along the capacity axis —
+    the inverse of :func:`concat_batches` and the counterpart of the reference
+    GPU emitter's ``create_sub_batch`` (``wf/standard_nodes_gpu.hpp``). Lane
+    content (including the validity mask) is preserved verbatim, so results
+    are invariant to the split. Requires exact divisibility: the control
+    plane's capacity ladder is built so every down-rung divides the base."""
+    c = batch.capacity
+    capacity = int(capacity)
+    if capacity < 1 or c % capacity:
+        raise ValueError(f"split_batch: capacity {capacity} does not divide "
+                         f"the batch capacity {c}")
+    if capacity == c:
+        return [batch]
+    cut = lambda a, s: a[s:s + capacity]
+    return [Batch(key=cut(batch.key, s), id=cut(batch.id, s),
+                  ts=cut(batch.ts, s),
+                  payload=jax.tree.map(lambda a: cut(a, s), batch.payload),
+                  valid=cut(batch.valid, s))
+            for s in range(0, c, capacity)]
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class TupleRef:

@@ -82,6 +82,57 @@ def run_sweep(batches=(1 << 16, 1 << 18, 1 << 20), keyset=(1, 500, 10_000),
     return rows
 
 
+def run_adaptive(batches=(1 << 16, 1 << 18, 1 << 20), keyset=(1, 500, 10_000),
+                 names=("map_stateless", "map_stateful", "filter", "win_kf"),
+                 steps: int = 20, cache_path=None,
+                 ) -> List[Tuple[str, int, int, float]]:
+    """The autotuned counterpart of :func:`run_sweep`: for each workload the
+    control plane's :class:`~windflow_tpu.control.CapacityAutotuner` hill-
+    climbs the SAME capacity ladder the fixed sweep enumerates, measuring each
+    rung it visits with the same ``_throughput`` recipe — so the ``adaptive``
+    table rows are directly comparable with the fixed-ladder rows (chosen
+    capacity in the batch column, its measured rate in the rate column).
+    ``cache_path`` persists/consumes the tuning cache: a second call
+    warm-starts converged at the cached rung and measures only that rung."""
+    from ..control.autotune import (CapacityAutotuner, TuningCache,
+                                    chain_signature, device_kind,
+                                    payload_signature, tuning_key)
+    ladder = sorted(int(b) for b in batches)
+    cache = TuningCache(cache_path) if cache_path else None
+    rows = []
+    for keys in keyset:
+        for name in names:
+            def measure(batch):
+                wl = workloads(batch, keys, total=(steps + 2) * batch)
+                src, ops = wl[name]
+                step, states = _chain_step(ops, src, batch)
+                return _throughput(step, states, steps, batch)
+            key = None
+            if cache is not None:
+                # signature from freshly built (unbound) ops — the geometry
+                # attrs the signature reads are set at construction
+                src0, ops0 = workloads(ladder[0], keys, 4 * ladder[0])[name]
+                key = tuning_key(chain_signature(ops0),
+                                 payload_signature(src0.payload_spec()),
+                                 device_kind())
+            tuner = CapacityAutotuner(ladder, start_capacity=ladder[0],
+                                      cache=cache, cache_key=key,
+                                      name=f"sweep:{name}:k{keys}")
+            tps = None
+            while True:
+                tps = measure(tuner.capacity)
+                if tuner.converged:
+                    break           # warm start: one confirming measurement
+                nxt = tuner.observe(tps)
+                if tuner.converged and nxt is None:
+                    # converged on the rung just measured
+                    break
+                # converged with a switch back to the best rung: loop once
+                # more to measure/report the winner; otherwise keep climbing
+            rows.append((f"{name} (adaptive)", tuner.capacity, keys, tps))
+    return rows
+
+
 def render_markdown(rows, device: str) -> str:
     lines = [
         "# RESULTS — swept throughput (tuples/s)",
@@ -89,7 +140,9 @@ def render_markdown(rows, device: str) -> str:
         f"Device: {device}. Counterpart of the reference's committed sweep "
         "tables (`src/GPU_Tests/new_tests/results/results.org`; CUDA bars: "
         "~16.6M stateless, 11.8M stateful @500 keys, 0.44-0.64M @1 key, "
-        "~10M @10k keys).",
+        "~10M @10k keys). `(adaptive)` rows: the control plane's capacity "
+        "autotuner hill-climbed the same ladder — batch column = chosen "
+        "capacity.",
         "",
         "| workload | batch | keys | M tuples/s |",
         "|---|---|---|---|",
@@ -108,8 +161,15 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--out", default="RESULTS.md")
+    ap.add_argument("--no-adaptive", action="store_true",
+                    help="skip the autotuned rows (fixed-ladder sweep only)")
+    ap.add_argument("--tuning-cache", default=None,
+                    help="tuning-cache path for the adaptive rows (a second "
+                    "run warm-starts at the cached optimum)")
     args = ap.parse_args(argv)
     rows = run_sweep(steps=args.steps)
+    if not args.no_adaptive:
+        rows += run_adaptive(steps=args.steps, cache_path=args.tuning_cache)
     md = render_markdown(rows, str(jax.devices()[0]))
     with open(args.out, "w") as f:
         f.write(md)

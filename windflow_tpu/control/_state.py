@@ -1,0 +1,56 @@
+"""Process-wide control-plane counters and gauges.
+
+Same stance as ``runtime/faults.py``'s recovery counters: a flat dict behind a
+lock, bumped from the actuation points (shed decisions, throttle waits,
+capacity switches) and surfaced by ``observability.MetricsRegistry.snapshot``
+under the ``"control"`` section and by ``to_prometheus`` as
+``windflow_control_<name>_total`` (counters) / ``windflow_control_<name>``
+(gauges). Kept in its own module so ``config``/``admission``/``governor``/
+``autotune`` can import it without touching the package ``__init__``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_COUNTER_NAMES = (
+    "admitted_batches", "admitted_tuples", "shed_batches", "shed_tuples",
+    "throttle_events", "throttle_seconds", "capacity_switches",
+    "tuning_decisions", "tuning_cache_hits",
+)
+
+_counters: Dict[str, float] = {k: 0 for k in _COUNTER_NAMES}
+_gauges: Dict[str, float] = {}
+_lock = threading.Lock()
+
+
+def bump(name: str, n: float = 1) -> None:
+    """Increment a process-wide control counter (monotonic; rendered as
+    ``windflow_control_<name>_total``)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Publish a control gauge (last-write-wins; e.g. ``chosen_capacity``)."""
+    with _lock:
+        _gauges[name] = value
+
+
+def counters() -> Dict[str, float]:
+    with _lock:
+        return dict(_counters)
+
+
+def gauges() -> Dict[str, float]:
+    with _lock:
+        return dict(_gauges)
+
+
+def reset() -> None:
+    """Zero everything (test isolation)."""
+    with _lock:
+        for k in list(_counters):
+            _counters[k] = 0
+        _gauges.clear()

@@ -1,0 +1,110 @@
+"""Backpressure governor — per-edge high/low watermarks over SPSC ring depths.
+
+The threaded drivers' SPSC rings already provide *implicit* backpressure (a
+full ring blocks the producer inside ``push``), but blocking there is
+invisible: the producer wedges mid-push with no signal, no counter, and the
+ring sits pegged at capacity. The governor makes backpressure *explicit and
+observable*: the source loop calls :meth:`throttle` before each push; when any
+watched edge's depth reaches its high watermark the source pauses — setting
+``pause_event`` so a prefetch worker (``operators/source.py::
+prefetch_to_device``) stops starting new H2D transfers too — until every edge
+drains to its low watermark. Every throttle episode is counted
+(``windflow_control_throttle_*``) and journaled.
+
+Watermarks are fractions of each edge's ring capacity (defaults 0.75 / 0.25),
+so per-edge capacities (the ``queue_capacity`` dict/callable on the threaded
+drivers) automatically scale their thresholds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..observability import journal as _journal
+from . import _state
+
+
+class BackpressureGovernor:
+    """Throttles a source loop on downstream ring depth."""
+
+    def __init__(self, high_watermark: float = 0.75,
+                 low_watermark: float = 0.25, poll_s: float = 0.001,
+                 clock=time.monotonic):
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        self.poll_s = float(poll_s)
+        self.clock = clock
+        #: set while the governor is actively throttling — the prefetch
+        #: pause hook (pass it as ``pause_event=`` to ``batches_prefetched``)
+        self.pause_event = threading.Event()
+        self.throttles = 0                    # episodes (per-governor, tests)
+        self._edges: List[Tuple[str, Callable[[], int], int, int]] = []
+        self._stop = threading.Event()
+
+    def watch(self, edge: str, size_fn: Callable[[], int],
+              capacity: int) -> None:
+        """Register one ring: ``size_fn`` probes its live depth."""
+        hi = max(1, int(capacity * self.high_watermark))
+        lo = min(max(0, int(capacity * self.low_watermark)), hi - 1)
+        self._edges.append((edge, size_fn, hi, lo))
+
+    def _over_high(self) -> Optional[Tuple[str, int, int]]:
+        for edge, size_fn, hi, _lo in self._edges:
+            try:
+                d = int(size_fn())
+            except Exception:                 # noqa: BLE001 — ring freed at EOS
+                continue
+            if d >= hi:
+                return edge, d, hi
+        return None
+
+    def _all_low(self) -> bool:
+        for _edge, size_fn, _hi, lo in self._edges:
+            try:
+                if int(size_fn()) > lo:
+                    return False
+            except Exception:                 # noqa: BLE001
+                continue
+        return True
+
+    def throttle(self, heartbeat=None) -> float:
+        """Called by the source loop before each push. Returns seconds spent
+        throttled (0.0 on the fast path: one depth probe per edge).
+        ``heartbeat`` (optional zero-arg callable) is invoked every poll so a
+        stage watchdog can tell an intentional throttle wait from a hang."""
+        over = self._over_high()
+        if over is None or self._stop.is_set():
+            return 0.0
+        edge, depth, hi = over
+        self.throttles += 1
+        _state.bump("throttle_events")
+        _journal.record("throttle", edge=edge, depth=depth, high=hi)
+        self.pause_event.set()
+        t0 = self.clock()
+        try:
+            while not self._stop.is_set() and not self._all_low():
+                if heartbeat is not None:
+                    heartbeat()
+                time.sleep(self.poll_s)
+        finally:
+            self.pause_event.clear()
+        dt = self.clock() - t0
+        _state.bump("throttle_seconds", dt)
+        _journal.record("throttle_end", edge=edge, waited_s=round(dt, 6))
+        return dt
+
+    def stop(self) -> None:
+        """Release any in-flight throttle wait (failure/teardown path: a dead
+        consumer must not leave the source wedged in the governor)."""
+        self._stop.set()
+        self.pause_event.clear()
+
+
+def governor_from_config(cfg, clock=time.monotonic,
+                         ) -> Optional[BackpressureGovernor]:
+    if cfg is None or not cfg.backpressure:
+        return None
+    return BackpressureGovernor(cfg.high_watermark, cfg.low_watermark,
+                                cfg.throttle_poll_s, clock=clock)

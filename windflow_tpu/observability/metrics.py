@@ -111,6 +111,14 @@ def _recovery_counters() -> Dict[str, float]:
     return _faults.counters()
 
 
+def _control_section() -> Dict[str, Dict[str, float]]:
+    """Process-wide control-plane counters (shed/throttle/switch totals) and
+    gauges (chosen capacity) — lazy import for the same no-reverse-edge
+    reason as the recovery counters."""
+    from .. import control as _control
+    return {"counters": _control.counters(), "gauges": _control.gauges()}
+
+
 class MetricsRegistry:
     """Aggregates every ``Stats_Record`` of a running graph into one snapshot.
 
@@ -139,6 +147,7 @@ class MetricsRegistry:
         self._operators: List[Any] = []
         self._gauges: Dict[str, Callable[[], Any]] = {}
         self._queue_gauges: Dict[str, Callable[[], int]] = {}
+        self._queue_capacities: Dict[str, int] = {}
         self._prev: Dict[int, tuple] = {}    # id(op) -> (t, inputs, outputs)
         self._lock = threading.Lock()
 
@@ -159,11 +168,16 @@ class MetricsRegistry:
     def attach_gauge(self, name: str, fn: Callable[[], Any]) -> None:
         self._gauges[name] = fn
 
-    def attach_queue_gauge(self, edge: str, fn: Callable[[], int]) -> None:
+    def attach_queue_gauge(self, edge: str, fn: Callable[[], int],
+                           capacity: Optional[int] = None) -> None:
         """SPSC ring depth probe for one dataflow edge (threaded driver):
         depth/capacity is the backpressure signal — a persistently full ring
-        means the consumer pipe is the bottleneck."""
+        means the consumer pipe is the bottleneck. ``capacity`` (when known)
+        is exposed alongside the depth, so watermark fractions are computable
+        from the snapshot alone."""
         self._queue_gauges[edge] = fn
+        if capacity is not None:
+            self._queue_capacities[edge] = int(capacity)
 
     def record_e2e(self, seconds: float) -> None:
         self.e2e_hist.record(seconds)
@@ -329,7 +343,12 @@ class MetricsRegistry:
             # dead-lettered poison batches, checkpoint validation outcomes,
             # watchdog timeouts, injected faults) — runtime/faults.py
             "recovery": _recovery_counters(),
+            # control-plane counters/gauges (shed/throttle/capacity-switch
+            # totals, chosen capacity) — windflow_tpu/control
+            "control": _control_section(),
         }
+        if self._queue_capacities:
+            snap["queue_capacity"] = dict(self._queue_capacities)
         if gauges:
             snap["gauges"] = gauges
         return snap
@@ -370,6 +389,12 @@ class MetricsRegistry:
         for edge, depth in snap["queues"].items():
             lines.append(f'windflow_queue_depth{{graph="{esc(g)}",'
                          f'edge="{esc(edge)}"}} {depth}')
+        qcaps = snap.get("queue_capacity") or {}
+        if qcaps:
+            lines.append("# TYPE windflow_queue_capacity gauge")
+            for edge, cap in qcaps.items():
+                lines.append(f'windflow_queue_capacity{{graph="{esc(g)}",'
+                             f'edge="{esc(edge)}"}} {cap}')
         # service-time histograms, straight from the live LogHistograms
         lines.append("# TYPE windflow_service_time_seconds histogram")
         with self._lock:
@@ -404,6 +429,14 @@ class MetricsRegistry:
             lines.append(f"# TYPE windflow_recovery_{k}_total counter")
             lines.append(f'windflow_recovery_{k}_total{{graph="{esc(g)}"}} '
                          f'{round(v, 6)}')
+        control = snap.get("control") or _control_section()
+        for k, v in sorted((control.get("counters") or {}).items()):
+            lines.append(f"# TYPE windflow_control_{k}_total counter")
+            lines.append(f'windflow_control_{k}_total{{graph="{esc(g)}"}} '
+                         f'{round(v, 6)}')
+        for k, v in sorted((control.get("gauges") or {}).items()):
+            lines.append(f"# TYPE windflow_control_{k} gauge")
+            lines.append(f'windflow_control_{k}{{graph="{esc(g)}"}} {v}')
         lines.append(f'windflow_uptime_seconds{{graph="{esc(g)}"}} '
                      f'{snap["uptime_s"]}')
         return "\n".join(lines) + "\n"

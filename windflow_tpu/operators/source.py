@@ -21,6 +21,7 @@ mask-padded, never shape-changed — the no-recompilation flush discipline.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Iterator, Optional
 
 import jax
@@ -34,14 +35,20 @@ from ..meta import classify_source
 from .base import Basic_Operator
 
 
-def prefetch_to_device(host_batches: Iterator[Batch], depth: int = 3
-                       ) -> Iterator[Batch]:
+def prefetch_to_device(host_batches: Iterator[Batch], depth: int = 3,
+                       pause_event=None) -> Iterator[Batch]:
     """Double-buffered host->device ingest: a worker thread pulls host batches,
     starts their (asynchronous) ``jax.device_put`` transfers, and keeps up to
     ``depth`` in flight in a bounded queue — H2D transfer of batch N+1 overlaps
     device compute of batch N. This is the reference GPU operators' pinned-buffer
     ``cudaMemcpyAsync`` + double-buffering protocol (``wf/map_gpu_node.hpp:224-340``)
-    at the source boundary. Exceptions in the worker re-raise at the consumer."""
+    at the source boundary. Exceptions in the worker re-raise at the consumer.
+
+    ``pause_event``: optional ``threading.Event`` — while SET, the worker stops
+    pulling host batches / starting new transfers (batches already in the
+    bounded queue remain consumable). The backpressure governor's hook
+    (``control/governor.py``): when a downstream stage falls behind, ingest
+    pauses instead of piling transfers onto a congested device."""
     import queue
     import threading
 
@@ -61,6 +68,9 @@ def prefetch_to_device(host_batches: Iterator[Batch], depth: int = 3
     def worker():
         try:
             for hb in host_batches:
+                while (pause_event is not None and pause_event.is_set()
+                       and not stop.is_set()):
+                    time.sleep(0.001)
                 if not put_guarded(jax.device_put(hb)):
                     return
             put_guarded(END)
@@ -102,12 +112,14 @@ class SourceBase(Basic_Operator):
         return batch_size
 
     def batches_prefetched(self, batch_size: int = DEFAULT_BATCH_SIZE,
-                           depth: int = 3) -> Iterator[Batch]:
+                           depth: int = 3, pause_event=None) -> Iterator[Batch]:
         """The ingest-overlap path: host framing + H2D transfers run in a worker
-        thread ``depth`` batches ahead of the consumer (bounded — backpressure)."""
+        thread ``depth`` batches ahead of the consumer (bounded — backpressure).
+        ``pause_event`` (a ``threading.Event``) suspends the worker while set —
+        the backpressure governor's actuation hook."""
         host_iter = getattr(self, "_host_batches", None)
         src = host_iter(batch_size) if host_iter else self.batches(batch_size)
-        return prefetch_to_device(src, depth)
+        return prefetch_to_device(src, depth, pause_event=pause_event)
 
     def payload_spec(self) -> Any:
         raise NotImplementedError

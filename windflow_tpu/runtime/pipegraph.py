@@ -253,7 +253,8 @@ class PipeGraph:
     """The streaming environment (``wf/pipegraph.hpp:104-244``)."""
 
     def __init__(self, name: str = "pipegraph", mode: Mode = Mode.DEFAULT,
-                 batch_size: int = None, monitoring=None):
+                 batch_size: int = None, monitoring=None, control=None,
+                 queue_capacity=8):
         self.name = name
         self.mode = mode
         #: None = resolve at start(): min withBatch hint over registered
@@ -267,6 +268,16 @@ class PipeGraph:
         #: cost beyond a None check).
         self._monitoring_arg = monitoring
         self._monitor = None
+        #: control-plane opt-in (mirrors monitoring=/faults=): None = consult
+        #: WF_CONTROL; resolved at start(). Admission control gates every
+        #: source loop; the backpressure governor throttles the threaded
+        #: driver's sources on SPSC ring watermarks.
+        self._control_arg = control
+        self._control = None
+        #: SPSC ring capacity for the threaded driver's dataflow edges: one
+        #: int for all, a dict keyed by edge label ("src->2", "0->1", by
+        #: consumer pipe index), or a callable (label, index) -> int.
+        self.queue_capacity = queue_capacity
         self._e2e_t0 = None           # in-flight e2e latency sample start
         self._roots: List[MultiPipe] = []
         self._merged_roots: List[MultiPipe] = []
@@ -312,6 +323,18 @@ class PipeGraph:
                 self._monitor = Monitor(cfg, self.name)
                 self._monitor.registry.register_graph(self)
                 self._monitor.start()
+        if self._control is None:
+            from ..control import ControlConfig
+            self._control = ControlConfig.resolve(self._control_arg)
+
+    def _make_admissions(self, driver: str):
+        """Per-source admission controllers over ONE shared token bucket
+        (total-ingest rate limit, per-source holding cells), keyed by root
+        pipe id. Every value is None when admission is off."""
+        from ..control import admission_group
+        group = admission_group(self._control, self.batch_size,
+                                len(self._roots), driver=driver)
+        return {id(mp): adm for mp, adm in zip(self._roots, group)}
 
     def run_supervised(self, *, checkpoint_every: int = 8,
                        max_restarts: int = 3, **hardening):
@@ -341,17 +364,28 @@ class PipeGraph:
         in_queues = {id(p): [] for p in pipes}
         out_edges = {}                           # (producer id, consumer id) -> queue
         channel_of = {}                          # queue id -> merge channel index
+        from .threaded import _resolve_edge_capacity
+        from ..control import governor_from_config
+        governor = governor_from_config(self._control)
+        admissions = self._make_admissions("graph-threaded")
+        edge_count = [0]
 
         def add_edge(src_id, dst):
-            q = SPSCQueue(8)
+            label = (f"src->{pipe_idx[id(dst)]}" if src_id == "src"
+                     else f"{pipe_idx[src_id]}->{pipe_idx[id(dst)]}")
+            cap = _resolve_edge_capacity(self.queue_capacity, label,
+                                         edge_count[0])
+            edge_count[0] += 1
+            q = SPSCQueue(cap)
             in_queues[id(dst)].append(q)
             out_edges[(src_id, id(dst))] = q
             if self._monitor is not None:
                 # live ring-depth gauge per dataflow edge: depth near capacity
                 # = backpressure, the consumer pipe is the bottleneck
-                label = (f"src->{pipe_idx[id(dst)]}" if src_id == "src"
-                         else f"{pipe_idx[src_id]}->{pipe_idx[id(dst)]}")
-                self._monitor.registry.attach_queue_gauge(label, q.size)
+                self._monitor.registry.attach_queue_gauge(label, q.size,
+                                                          capacity=cap)
+            if governor is not None:
+                governor.watch(label, q.size, cap)
             return q
 
         for p in pipes:
@@ -431,6 +465,9 @@ class PipeGraph:
                     mp.sink.consume(None)
             except BaseException as e:          # noqa: BLE001 — re-raised at join
                 errors.append(e)
+                if governor is not None:
+                    governor.stop()     # a throttled source must not wait on
+                                        # a ring this dead pipe will drain
                 # drain the remaining input rings to EOS so upstream producers
                 # blocked on a full ring behind this dead pipe can finish and
                 # send their own EOS (otherwise the join above deadlocks)
@@ -444,10 +481,23 @@ class PipeGraph:
         def source_body(mp):
             from .pipeline import record_source_launch
             q = out_edges[("src", id(mp))]
+            adm = admissions.get(id(mp))
             try:
+                n = 0
                 for batch in mp.source.batches(self.batch_size):
                     record_source_launch(mp.source, batch)
-                    q.push(batch)
+                    admitted = (batch,) if adm is None else adm.offer(batch,
+                                                                      pos=n)
+                    for ab in admitted:
+                        if governor is not None:
+                            governor.throttle()
+                        q.push(ab)
+                    n += 1
+                if adm is not None:
+                    for ab in adm.drain():
+                        if governor is not None:
+                            governor.throttle()
+                        q.push(ab)
             except BaseException as e:          # noqa: BLE001
                 errors.append(e)
             finally:
@@ -475,6 +525,8 @@ class PipeGraph:
             self._ended = True
             return self._results()
         finally:
+            if governor is not None:
+                governor.stop()
             if self._monitor is not None:
                 self._monitor.finish(self)
 
@@ -489,6 +541,7 @@ class PipeGraph:
         from .pipeline import record_source_launch
         from ..observability import journal as _journal
         try:
+            admissions = self._make_admissions("graph")
             sources = [(mp, mp.source.batches(self.batch_size))
                        for mp in self._roots]
             live = list(sources)
@@ -500,18 +553,26 @@ class PipeGraph:
                     batch = next(it)
                 except StopIteration:
                     live.remove((mp, it))
+                    adm = admissions.get(id(mp))
+                    if adm is not None:
+                        for ab in adm.drain():  # bounded held tail
+                            self._push(mp, ab)
                     self._exhaust(mp)
                     continue
-                if (self._monitor is not None
-                        and self._monitor.config.should_sample_e2e(n_pushed)):
-                    # e2e latency sample: source framing -> first sink's host
-                    # receipt (recorded in _deliver after sink.consume)
-                    self._e2e_t0 = _time.perf_counter()
-                self._push(mp, batch)
-                self._e2e_t0 = None
-                n_pushed += 1
-                round_robin_pos += 1
                 record_source_launch(mp.source, batch)
+                adm = admissions.get(id(mp))
+                admitted = (batch,) if adm is None else adm.offer(batch,
+                                                                  pos=n_pushed)
+                round_robin_pos += 1
+                for ab in admitted:
+                    if (self._monitor is not None
+                            and self._monitor.config.should_sample_e2e(n_pushed)):
+                        # e2e latency sample: source framing -> first sink's
+                        # host receipt (recorded in _deliver after sink.consume)
+                        self._e2e_t0 = _time.perf_counter()
+                    self._push(mp, ab)
+                    self._e2e_t0 = None
+                    n_pushed += 1
             # EOS: flush every pipe in topological order; a merged pipe first
             # drains its Ordering_Node (tuples held back by the low-watermark)
             pipe_idx = {id(p): i for i, p in enumerate(self._all_pipes())}

@@ -117,6 +117,18 @@ class CompiledChain:
         self._push_count = 0
         self._nbytes_cache = {}     # (from_op, in capacity) -> (in, out bytes)
 
+    def warm(self, capacity: int) -> None:
+        """Trace + compile the full-chain step for ``capacity`` WITHOUT
+        touching operator state: a functional dry-run on an all-invalid batch
+        whose outputs are discarded (``step`` is pure, so the real states are
+        untouched). jax.jit caches one executable per input shape, so after
+        warming every rung of a capacity ladder the autotuner's switches pick
+        cached executables — the hot path never pays a trace/compile."""
+        b = Batch.empty(capacity, self.specs[0])
+        if self.device is not None:
+            b = jax.device_put(b, self.device)
+        self._step_fn(0)(tuple(self.states), b)
+
     def reset_states(self) -> None:
         """Re-initialize every operator's state (supervised replay of a chain
         that did not exist at the last checkpoint)."""
@@ -233,7 +245,7 @@ class Pipeline:
     def __init__(self, source: SourceBase, ops: Sequence[Basic_Operator],
                  sink: Optional[Sink] = None, *,
                  batch_size: Optional[int] = None, prefetch: int = 0,
-                 monitoring=None):
+                 monitoring=None, control=None):
         self.source = source
         self.sink = sink
         if batch_size is None:
@@ -241,15 +253,75 @@ class Pipeline:
             batch_size = resolve_batch_hint(ops) or DEFAULT_BATCH_SIZE
         self.batch_size = batch_size
         self.prefetch = int(prefetch)   # >0: overlapped host framing + H2D transfers
+        #: prefetch pause hook: the backpressure governor (or any external
+        #: controller) sets this Event to suspend the prefetch worker
+        import threading as _threading
+        self.prefetch_pause = _threading.Event()
         chain_ops = list(ops)
         cap = getattr(source, "out_capacity", lambda b: b)(batch_size)
+        #: adaptive control plane (None = off, the default — today's exact
+        #: code path, no controller state). Resolved HERE (not lazily like
+        #: monitoring) because the capacity ladder governs chain geometry:
+        #: autotuning binds the operators at the ladder's top rung so every
+        #: smaller rung runs inside the same (oversized-is-safe) rings.
+        from ..control import ControlConfig
+        self._control = ControlConfig.resolve(control)
+        self._ladder = None
+        chain_cap = cap
+        if self._control is not None and self._control.autotune:
+            from ..control import build_ladder
+            self._ladder = build_ladder(cap, up=self._control.ladder_up,
+                                        down=self._control.ladder_down)
+            chain_cap = self._ladder[-1]
         self.chain = CompiledChain(chain_ops, source.payload_spec(),
-                                   batch_capacity=cap)
+                                   batch_capacity=chain_cap)
         #: None = consult WF_MONITORING; True/str/MonitoringConfig = enable
         #: (see observability.MonitoringConfig.resolve); resolved lazily so an
         #: env change between construction and run() is honored
         self._monitoring_arg = monitoring
         self._monitor = None
+
+    def _make_controller(self):
+        """Assemble the run-scoped control pieces from the resolved config:
+        (autotuner, rebatcher, admission) — any of them None when that
+        sub-system is off."""
+        cfg = self._control
+        if cfg is None:
+            return None, None, None
+        from ..control import (CapacityAutotuner, Rebatcher, TuningCache,
+                               admission_from_config, chain_signature,
+                               device_kind, payload_signature, tuning_key)
+        base = getattr(self.source, "out_capacity",
+                       lambda b: b)(self.batch_size)
+        tuner = rebatcher = None
+        if cfg.autotune and self._ladder and len(self._ladder) > 1:
+            cache = key = None
+            if cfg.cache_path:
+                cache = TuningCache(cfg.cache_path)
+                key = tuning_key(chain_signature(self.chain.ops),
+                                 payload_signature(self.chain.specs[0]),
+                                 device_kind())
+            tuner = CapacityAutotuner(
+                self._ladder, start_capacity=base,
+                decide_every=cfg.decide_every,
+                settle_batches=cfg.settle_batches,
+                improve_threshold=cfg.improve_threshold,
+                cache=cache, cache_key=key,
+                name=self.source.getName() + "-pipeline")
+            rebatcher = Rebatcher(base)
+            if tuner.capacity != base:        # cache warm start: actuate now
+                rebatcher.set_target(tuner.capacity)
+            if cfg.prewarm:
+                # a converged warm start only ever runs the cached rung plus
+                # the base shape (rebatcher drain/passthrough) — compiling
+                # the rest of the ladder would spend seconds on executables
+                # that cannot execute
+                warm_caps = ({tuner.capacity, base} if tuner.converged
+                             else self._ladder)
+                for c in sorted(warm_caps):
+                    self.chain.warm(c)
+        admission = admission_from_config(cfg, base, driver="pipeline")
+        return tuner, rebatcher, admission
 
     def run(self):
         import time as _time
@@ -260,20 +332,29 @@ class Pipeline:
             self._monitor.registry.register_pipeline(self)
             self._monitor.start()
         mon = self._monitor
+        tuner, rebatcher, admission = self._make_controller()
+        if mon is not None and tuner is not None:
+            mon.registry.attach_gauge("control_chosen_capacity",
+                                      lambda: tuner.capacity)
         try:
-            batches = (self.source.batches_prefetched(self.batch_size,
-                                                      self.prefetch)
+            batches = (self.source.batches_prefetched(
+                           self.batch_size, self.prefetch,
+                           pause_event=self.prefetch_pause)
                        if self.prefetch else self.source.batches(self.batch_size))
             n = 0
-            for batch in batches:
-                record_source_launch(self.source, batch)
+
+            def drive(b):
+                # push one chain-capacity batch + sink delivery + sampling;
+                # with control off this runs exactly once per source batch —
+                # today's code path
+                nonlocal n
                 # e2e sampling needs a host sink (its consume blocks on the
                 # materialized result — the "receipt"); in-graph ReduceSinks
                 # have no host receipt to time
                 sampled = (mon is not None and self.sink is not None
                            and mon.config.should_sample_e2e(n))
                 t0 = _time.perf_counter() if sampled else 0.0
-                out = self.chain.push(batch)
+                out = self.chain.push(b)
                 if self.sink is not None:
                     self.sink.consume(out)
                 if sampled:
@@ -282,8 +363,29 @@ class Pipeline:
                     # host-receipt sample through device compute + transfer
                     mon.registry.record_e2e(_time.perf_counter() - t0)
                 n += 1
+                if tuner is not None:
+                    newcap = tuner.on_batch(b.capacity)
+                    if newcap is not None:
+                        rebatcher.set_target(newcap)
+
+            for batch in batches:
+                record_source_launch(self.source, batch)
+                admitted = (batch,) if admission is None \
+                    else admission.offer(batch, pos=n)
+                for ab in admitted:
+                    for rb in (rebatcher.feed(ab) if rebatcher is not None
+                               else (ab,)):
+                        drive(rb)
             from ..observability import journal as _journal
             _journal.record("eos", pipeline=self.source.getName())
+            if admission is not None:
+                for ab in admission.drain():      # bounded held tail
+                    for rb in (rebatcher.feed(ab) if rebatcher is not None
+                               else (ab,)):
+                        drive(rb)
+            if rebatcher is not None:
+                for rb in rebatcher.drain():      # partial up-rung buffer
+                    drive(rb)
             for out in self.chain.flush():
                 if self.sink is not None:
                     self.sink.consume(out)

@@ -58,20 +58,38 @@ _EOS = object()
 _DRAIN_TIMEOUT_S = 30.0
 
 
+def _resolve_edge_capacity(spec, name: str, index: int, default: int = 8) -> int:
+    """Per-edge SPSC ring capacity: ``spec`` is one int for every edge (the
+    historical behavior), a dict keyed by edge name or index (missing edges
+    fall back to the default), or a callable ``(name, index) -> int``."""
+    if callable(spec):
+        cap = spec(name, index)
+    elif isinstance(spec, dict):
+        cap = spec.get(name, spec.get(index, default))
+    else:
+        cap = spec
+    cap = int(cap)
+    if cap < 1:
+        raise ValueError(f"edge {name!r}: queue capacity must be >= 1, got {cap}")
+    return cap
+
+
 class ThreadedPipeline:
     """Source -> [segment chains...] -> sink, one host thread per stage."""
 
     def __init__(self, source: SourceBase, segments: Sequence[Sequence],
                  sink: Optional[Sink] = None, *,
                  batch_size: int = DEFAULT_BATCH_SIZE,
-                 queue_capacity: int = 8, pin: bool = True,
-                 heartbeat_timeout: Optional[float] = None, faults=None):
+                 queue_capacity=8, pin: bool = True,
+                 heartbeat_timeout: Optional[float] = None, faults=None,
+                 prefetch: int = 0, control=None):
         self.source = source
         self.sink = sink
         self.batch_size = batch_size
         self.pin = pin
         self.heartbeat_timeout = heartbeat_timeout
         self._faults_arg = faults
+        self.prefetch = int(prefetch)   # >0: prefetched (overlapped H2D) ingest
         spec = source.payload_spec()
         self.chains: List[CompiledChain] = []
         cap = getattr(source, "out_capacity", lambda b: b)(batch_size)
@@ -81,12 +99,35 @@ class ThreadedPipeline:
             for op in chain.ops:
                 cap = op.out_capacity(cap)
             self.chains.append(chain)
-        # queue i feeds chain i; last queue feeds the sink thread
-        self.queues = [SPSCQueue(queue_capacity) for _ in range(len(self.chains) + 1)]
+        # queue i feeds chain i; last queue feeds the sink thread. Edges are
+        # named so hot edges can be sized independently: ``queue_capacity``
+        # is one int (every edge, the historical default), a dict keyed by
+        # edge name or index, or a callable ``(name, index) -> int``.
+        n = len(self.chains)
+        self.edge_names = [("src->seg0" if n else "src->sink")] + \
+            [f"seg{i}->" + (f"seg{i + 1}" if i + 1 < n else "sink")
+             for i in range(n)]
+        self.edge_capacities = {
+            name: _resolve_edge_capacity(queue_capacity, name, i)
+            for i, name in enumerate(self.edge_names)}
+        self.queues = [SPSCQueue(self.edge_capacities[name])
+                       for name in self.edge_names]
+        #: adaptive control plane (off by default): backpressure governor over
+        #: the rings + admission control at the source. Autotuning does not
+        #: apply here — each segment chain's capacity is its queue contract.
+        from ..control import ControlConfig
+        self._control = ControlConfig.resolve(control)
+        self.governor = None
+        self._admission = None
         self._errors: List[BaseException] = []
         self._beats = {}                    # stage name -> last heartbeat (monotonic)
         self._done = set()                  # stages that exited
         self.watchdog_stale: List[str] = [] # stages the watchdog flagged
+
+    def queue_depths(self) -> dict:
+        """Live ring depth per edge name (the backpressure signal)."""
+        return {name: q.size()
+                for name, q in zip(self.edge_names, self.queues)}
 
     # -- failure path -----------------------------------------------------------------
 
@@ -106,14 +147,36 @@ class ThreadedPipeline:
         from .pipeline import record_source_launch
         stage = "source"
         self._beats[stage] = time.monotonic()
+        gov, adm = self.governor, self._admission
         try:
+            if self.prefetch:
+                batches = self.source.batches_prefetched(
+                    self.batch_size, self.prefetch,
+                    pause_event=gov.pause_event if gov is not None else None)
+            else:
+                batches = self.source.batches(self.batch_size)
             n = 0
-            for batch in self.source.batches(self.batch_size):
+            for batch in batches:
                 self._beats[stage] = time.monotonic()
                 _faults.fire("source.next", stage=stage, pos=n)
                 record_source_launch(self.source, batch)
-                self.queues[0].push(batch)
+                admitted = (batch,) if adm is None else adm.offer(batch, pos=n)
+                for ab in admitted:
+                    if gov is not None:
+                        # a throttle wait beats the heartbeat: backpressure is
+                        # intentional, not a hang the watchdog should flag
+                        gov.throttle(heartbeat=lambda: self._beats.__setitem__(
+                            stage, time.monotonic()))
+                        self._beats[stage] = time.monotonic()
+                    self.queues[0].push(ab)
                 n += 1
+            if adm is not None:
+                for ab in adm.drain():      # bounded held tail (drop_oldest)
+                    if gov is not None:
+                        gov.throttle(heartbeat=lambda: self._beats.__setitem__(
+                            stage, time.monotonic()))
+                        self._beats[stage] = time.monotonic()
+                    self.queues[0].push(ab)
         except BaseException as e:          # noqa: BLE001 — propagated to join
             self._errors.append(e)
         finally:
@@ -145,6 +208,9 @@ class ThreadedPipeline:
                 n += 1
         except BaseException as e:          # noqa: BLE001
             self._errors.append(e)
+            if self.governor is not None:
+                self.governor.stop()        # a throttled source must not wait
+                                            # on a ring this stage will drain
             if not eos_seen:
                 self._drain_to_eos(q_in)    # unwedge the upstream producer
         finally:
@@ -176,6 +242,8 @@ class ThreadedPipeline:
                 self.sink.consume(None)
         except BaseException as e:          # noqa: BLE001
             self._errors.append(e)
+            if self.governor is not None:
+                self.governor.stop()
             if not eos_seen:
                 self._drain_to_eos(q)       # unwedge the upstream producer
         finally:
@@ -201,8 +269,26 @@ class ThreadedPipeline:
 
     def run(self):
         injector = _faults.resolve(self._faults_arg)
+        cfg = self._control
+        if cfg is not None:
+            from ..control import admission_from_config, governor_from_config
+            self.governor = governor_from_config(cfg)
+            if self.governor is not None:
+                for name, q in zip(self.edge_names, self.queues):
+                    self.governor.watch(name, q.size,
+                                        self.edge_capacities[name])
+            self._admission = admission_from_config(
+                cfg, getattr(self.source, "out_capacity",
+                             lambda b: b)(self.batch_size),
+                driver="threaded")
         with _faults.activate(injector):
-            return self._run()
+            try:
+                return self._run()
+            finally:
+                if self.governor is not None:
+                    # never leave a source wedged in a throttle wait past
+                    # teardown (the object stays readable for post-run stats)
+                    self.governor.stop()
 
     def _run(self):
         threads = [threading.Thread(target=self._source_body, args=(0,),
