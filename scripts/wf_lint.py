@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""wf_lint — run the framework invariant linter over this repository.
+
+Stdlib only (the linter module is loaded by file path, bypassing the
+``windflow_tpu`` package ``__init__`` and its JAX imports), so this works as
+a pre-commit hook on any box:
+
+    python scripts/wf_lint.py                    # text report
+    python scripts/wf_lint.py --format=json      # machine-readable
+    python scripts/wf_lint.py --update-baseline  # accept current findings
+
+Exit codes: 0 = clean (no non-baselined findings), 1 = findings, 2 =
+internal error (the linter itself failed — never confuse a broken gate
+with a clean one).
+
+Baseline: ``windflow_tpu/analysis/baseline.json`` suppresses pre-existing
+findings (override with ``--baseline`` or the ``WF_LINT_BASELINE`` env var);
+``--update-baseline`` rewrites it from the current findings so the gate
+fails only on regressions from here on.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    """Load analysis/lint.py directly — no package import, no JAX."""
+    path = os.path.join(REPO, "windflow_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("wf_analysis_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass field resolution looks the module up in sys.modules mid-exec
+    sys.modules["wf_analysis_lint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_lint", description="windflow_tpu framework invariant linter")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--root", default=REPO,
+                    help="repository root to lint (default: this repo)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file overriding analysis/baseline.json "
+                         "(WF_LINT_BASELINE env does the same)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    args = ap.parse_args(argv)
+
+    try:
+        lint = _load_lint()
+        cfg = lint.LintConfig(root=args.root)
+        if args.baseline:
+            # resolve against the INVOKER's cwd, not the lint root
+            os.environ["WF_LINT_BASELINE"] = os.path.abspath(args.baseline)
+        findings = lint.run_lint(cfg=cfg)
+        bpath = lint.baseline_path(cfg)
+        if args.update_baseline:
+            lint.save_baseline(bpath, findings)
+            print(f"wf_lint: wrote {len(findings)} finding(s) to {bpath}")
+            return 0
+        if args.no_baseline:
+            fresh, suppressed = findings, []
+        else:
+            fresh, suppressed = lint.split_baseline(cfg, findings)
+    except Exception as e:  # noqa: BLE001 — a broken linter must exit 2,
+        #                     never masquerade as a clean (0) or dirty (1) run
+        print(f"wf_lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [x.to_dict() for x in fresh],
+            "suppressed": len(suppressed),
+        }, indent=1))
+    else:
+        for x in fresh:
+            print(x.render())
+        print(f"wf_lint: {len(fresh)} finding(s) "
+              f"({len(suppressed)} baselined)")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

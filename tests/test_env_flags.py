@@ -1,84 +1,51 @@
-"""Static-analysis gate: every ``WF_*`` environment flag read anywhere in the
-tree must be documented in ``docs/ENV_FLAGS.md`` — including *when* it is read
-(the ADVICE round-5 footgun: trace-time reads are baked into cached
-executables, so an undocumented flag toggled mid-process silently does
-nothing). A new env read without a docs row fails tier-1."""
+"""Env-flag inventory gate — every ``WF_*`` environment variable read
+anywhere in the tree must be documented in ``docs/ENV_FLAGS.md`` including
+*when* it is read (the ADVICE round-5 footgun: trace-time reads are baked
+into cached executables, so an undocumented flag toggled mid-process silently
+does nothing).
+
+The scanner itself now lives in the invariant linter
+(``windflow_tpu/analysis/lint.py`` — rules WF201/WF202), so the CLI, the
+tier-1 lint gate (``tests/test_lint_clean.py``), and this focused test all
+share ONE source of truth. This file keeps the inventory's contract pinned
+directly: the rule finds real reads, and the known trace-time flags stay
+marked."""
 
 import os
-import re
+
+from windflow_tpu.analysis import lint
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DOC = os.path.join(ROOT, "docs", "ENV_FLAGS.md")
-
-#: a line is an env READ when it touches the environment (os.environ /
-#: getenv) or defines the default env-var name a reader resolves later
-#: (``var: str = "WF_FAULT_PLAN"`` in FaultPlan.from_env)
-_READ_LINE = re.compile(r"environ|getenv|var\s*:\s*str\s*=\s*\"WF_")
-_FLAG = re.compile(r"WF_[A-Z][A-Z0-9_]*")
+CFG = lint.LintConfig(root=ROOT)
 
 
-def _py_files():
-    scan_dirs = [os.path.join(ROOT, "windflow_tpu"),
-                 os.path.join(ROOT, "scripts")]
-    files = [os.path.join(ROOT, "bench.py")]
-    for d in scan_dirs:
-        for dirpath, _dirs, names in os.walk(d):
-            files += [os.path.join(dirpath, n) for n in names
-                      if n.endswith(".py")]
-    return files
-
-
-def _flags_read():
-    found = {}                       # flag -> first "file:line" seen
-    for path in _py_files():
-        rel = os.path.relpath(path, ROOT)
-        with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                if not _READ_LINE.search(line):
-                    continue
-                for flag in _FLAG.findall(line):
-                    found.setdefault(flag, f"{rel}:{lineno}")
-    return found
-
-
-def _documented():
-    """Parse the ENV_FLAGS.md table: {flag: read-at cell}."""
-    rows = {}
-    with open(DOC) as f:
-        for line in f:
-            m = re.match(r"\|\s*`(WF_[A-Z0-9_]+)`\s*\|([^|]*)\|", line)
-            if m:
-                rows[m.group(1)] = m.group(2).strip()
-    return rows
-
-
-def test_every_env_flag_read_is_documented():
-    read = _flags_read()
+def test_scanner_sees_env_reads_at_all():
+    """Guard against a silently-broken scanner (regex drift would make the
+    gate vacuously green)."""
+    read = lint.env_flags_read(ROOT, CFG)
     assert read, "the scanner found no WF_* env reads at all — it is broken"
-    docs = _documented()
-    missing = {f: where for f, where in read.items() if f not in docs}
-    assert not missing, (
-        f"WF_* env flags read in the tree but missing from docs/ENV_FLAGS.md "
-        f"(add a table row incl. the read-at column): {missing}")
+    # a representative spread: package run-time flag, default-name idiom
+    # (FaultPlan.from_env), trace-time flag, and the linter's own override
+    for flag in ("WF_MONITORING", "WF_FAULT_PLAN", "WF_LOOKUP_IMPL",
+                 "WF_LINT_BASELINE"):
+        assert flag in read, f"{flag} read site not found by the scanner"
 
 
-def test_every_documented_flag_states_read_time():
-    docs = _documented()
-    assert docs, "docs/ENV_FLAGS.md has no flag table rows"
-    bad = {f: cell for f, cell in docs.items()
-           if not re.search(r"trace|run time|process start|start", cell,
-                            re.I)}
-    assert not bad, (
-        f"ENV_FLAGS.md rows whose 'read at' cell does not state WHEN the "
-        f"flag is read (trace time vs run time vs process start): {bad}")
+def test_every_env_flag_read_is_documented_with_read_time():
+    """Rules WF201 (undocumented read) + WF202 (row missing the read-time
+    cell) over the live tree — add the ENV_FLAGS.md row in the same commit
+    that introduces a flag."""
+    findings = lint.rule_env_flags(CFG)
+    assert not findings, "\n".join(x.render() for x in findings)
 
 
 def test_known_trace_time_flags_marked():
-    """The four flags read inside jitted code paths must carry the trace-time
-    marking — the footgun the inventory exists to prevent."""
-    docs = _documented()
+    """The four flags read inside jitted code paths must carry the
+    trace-time marking — the footgun the inventory exists to prevent."""
+    docs = lint.parse_env_doc(os.path.join(ROOT, CFG.env_doc))
     for flag in ("WF_LOOKUP_IMPL", "WF_HISTOGRAM_IMPL",
                  "WF_HISTOGRAM_FORCE_FAST", "WF_ORDERING_SKIP_SORTED"):
         assert flag in docs, f"{flag} missing from ENV_FLAGS.md"
-        assert "trace" in docs[flag].lower(), (
+        _lineno, cell = docs[flag]
+        assert "trace" in cell.lower(), (
             f"{flag} is read at trace time but ENV_FLAGS.md does not say so")

@@ -1,0 +1,440 @@
+"""Pillar-2 gate: the framework invariant linter runs over THIS repository in
+tier-1 and fails on any finding not suppressed by ``analysis/baseline.json``
+— a regression gate, fast and CPU-only (the rules are stdlib ``ast``).
+
+Also unit-tests each rule against seeded fixture trees (every ``WF2xx`` code
+fires on a minimal violation and is silenced by its annotation), and pins the
+CLI's exit-code contract (0 clean / 1 findings / 2 internal error)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from windflow_tpu.analysis import lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ the repo gate
+
+
+def test_repo_lints_clean_against_baseline():
+    """THE gate: any new violation in windflow_tpu/ fails tier-1 with
+    file:line and code; pre-existing findings stay suppressed."""
+    fresh, suppressed = lint.lint_repo(ROOT)
+    assert not fresh, (
+        "new wf-lint findings (fix them, annotate with the wf-lint grammar "
+        "where legitimate, or — for genuinely pre-existing debt — run "
+        "scripts/wf_lint.py --update-baseline):\n"
+        + "\n".join(x.render() for x in fresh))
+
+
+def test_baseline_contains_only_real_findings():
+    """Every baseline entry still matches a live finding (count-aware) — a
+    stale entry means debt was paid off; shrink the baseline so it cannot
+    mask a future regression at the same (code, path, text)."""
+    findings = lint.run_lint(ROOT)
+    live: dict = {}
+    for x in findings:
+        live[x.key()] = live.get(x.key(), 0) + 1
+    base = lint.load_baseline(lint.baseline_path(lint.LintConfig(root=ROOT)))
+    stale = sorted(k for k, n in base.items() if n > live.get(k, 0))
+    assert not stale, (
+        f"stale baseline entries (regenerate with scripts/wf_lint.py "
+        f"--update-baseline): {stale}")
+
+
+def test_metrics_module_is_clean():
+    """Satellite pin: observability/metrics.py carries zero findings — its
+    donated/abstract-state except was narrowed to the concrete JAX errors."""
+    findings = lint.run_lint(ROOT)
+    mine = [x for x in findings
+            if x.path == "windflow_tpu/observability/metrics.py"]
+    assert not mine, "\n".join(x.render() for x in mine)
+
+
+def test_both_pillars_run_in_tier1():
+    """Pillar-1 presence in this gate file too: the canonical YSB pipeline
+    validates clean (the per-code suite is tests/test_analysis_validate.py)."""
+    import windflow_tpu as wf
+    from windflow_tpu.analysis import validate
+    from windflow_tpu.benchmarks import ysb
+    p = wf.Pipeline(ysb.make_source(total=8192), list(ysb.make_ops()),
+                    wf.Sink(lambda view: None), batch_size=1024)
+    report = validate(p)
+    assert report.ok, str(report)
+
+
+# ----------------------------------------------------------- rule fixtures
+
+
+_NAMES_PY = textwrap.dedent('''\
+    JOURNAL_EVENTS = ("good_event",)
+    RECOVERY_COUNTERS = ("good_counter",)
+    CONTROL_COUNTERS = ("good_control",)
+    CONTROL_GAUGES = ("good_gauge",)
+''')
+
+_ENV_DOC = textwrap.dedent('''\
+    # flags
+    | flag | read at | where | meaning |
+    |---|---|---|---|
+    | `WF_DOCUMENTED` | run time | somewhere | fine. |
+    | `WF_NO_TIME` | whenever | somewhere | row lacks a read-time word. |
+''')
+
+
+def _mini_repo(tmp_path, module_src, module_rel="windflow_tpu/mod.py"):
+    """A minimal repo skeleton the rules can run against."""
+    (tmp_path / "windflow_tpu" / "observability").mkdir(parents=True)
+    (tmp_path / "windflow_tpu" / "analysis").mkdir(parents=True)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "windflow_tpu" / "observability" / "names.py").write_text(
+        _NAMES_PY)
+    (tmp_path / "docs" / "ENV_FLAGS.md").write_text(_ENV_DOC)
+    mod = tmp_path / module_rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(textwrap.dedent(module_src))
+    return lint.LintConfig(
+        root=str(tmp_path),
+        deterministic_modules=(module_rel,),
+    )
+
+
+def _codes(findings):
+    return sorted(x.code for x in findings)
+
+
+def test_wf200_parse_error(tmp_path):
+    cfg = _mini_repo(tmp_path, "def broken(:\n")
+    assert "WF200" in _codes(lint.run_lint(cfg=cfg))
+
+
+def test_wf200_non_utf8_file_is_a_finding_not_a_crash(tmp_path):
+    cfg = _mini_repo(tmp_path, "pass\n")
+    (tmp_path / "windflow_tpu" / "latin.py").write_bytes(
+        b"# -*- coding: latin-1 -*-\nx = '\xe9'\n")
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF200"]
+    assert len(hits) == 1 and "UTF-8" in hits[0].message
+
+
+def test_wf201_undocumented_env_read(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        import os
+        X = os.environ.get("WF_UNDOCUMENTED", "")
+        Y = os.environ.get("WF_DOCUMENTED", "")
+    ''')
+    findings = lint.run_lint(cfg=cfg)
+    assert [x.code for x in findings if "WF_UNDOCUMENTED" in x.message] \
+        == ["WF201"]
+    assert not [x for x in findings if "WF_DOCUMENTED`" in x.message]
+
+
+def test_wf202_row_without_read_time(tmp_path):
+    cfg = _mini_repo(tmp_path, "pass\n")
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF202"]
+    assert len(hits) == 1 and "WF_NO_TIME" in hits[0].message
+    assert hits[0].path == "docs/ENV_FLAGS.md"
+
+
+def test_wf210_wall_clock_in_deterministic_module(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        import time, random
+        def bad():
+            return time.time(), time.monotonic(), random.random()
+        def ok():
+            return time.time()      # wf-lint: allow[wall-clock]
+    ''')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF210"]
+    assert len(hits) == 3, hits
+    # outside the deterministic module list, wall clocks are fine
+    cfg2 = _mini_repo(tmp_path / "b", '''
+        import time
+        def fine():
+            return time.time()
+    ''')
+    cfg2.deterministic_modules = ()
+    assert "WF210" not in _codes(lint.run_lint(cfg=cfg2))
+
+
+def test_wf210_aliased_imports_do_not_escape(tmp_path):
+    """`import time as _t` / `from time import monotonic` / `from random
+    import random as r` must be flagged like the literal spellings."""
+    cfg = _mini_repo(tmp_path, '''
+        import time as _t
+        from time import monotonic
+        from random import random as r
+        def bad():
+            return _t.time(), monotonic(), r()
+        def ok():
+            return _t.perf_counter()     # wf-lint: allow[wall-clock]
+    ''')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF210"]
+    assert len(hits) == 3, hits
+
+
+def test_wf241_aliased_imports_do_not_escape(tmp_path):
+    """Any import spelling of the counter emitters is resolved: the typo'd
+    name is flagged wherever bump() came from."""
+    cfg = _mini_repo(tmp_path, '''
+        from .runtime import faults as flt
+        from .runtime.faults import bump
+        def f():
+            flt.bump("typo_a")
+            bump("typo_b")
+            bump("good_counter")
+    ''')
+    hits = sorted(x.message for x in lint.run_lint(cfg=cfg)
+                  if x.code == "WF241")
+    assert len(hits) == 2 and "typo_a" in hits[0] and "typo_b" in hits[1]
+
+
+def test_wf220_guarded_attribute_outside_lock(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        import threading
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []          # wf-lint: guarded-by[_lock]
+                self.items.append(0)     # __init__ is exempt
+            def good(self):
+                with self._lock:
+                    return len(self.items)
+            def bad(self):
+                return len(self.items)
+            def annotated(self):
+                return self.items        # wf-lint: allow[unguarded]
+    ''')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF220"]
+    assert len(hits) == 1 and "Box.bad" in hits[0].message
+
+
+def test_wf220_nested_closure_under_lock_is_not_lock_held(tmp_path):
+    """A lambda/def DEFINED inside `with self._lock:` runs later, unlocked —
+    a deferred callback touching the guarded attribute must still be
+    flagged."""
+    cfg = _mini_repo(tmp_path, '''
+        import threading
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []          # wf-lint: guarded-by[_lock]
+            def deferred(self):
+                with self._lock:
+                    return lambda: self.items.pop(0)
+    ''')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF220"]
+    assert len(hits) == 1 and "Box.deferred" in hits[0].message
+
+
+def test_wf220_trailing_annotation_does_not_leak_to_next_line(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        import threading
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = []              # wf-lint: guarded-by[_lock]
+                self.b = 0
+            def touch_b(self):
+                return self.b            # b is NOT guarded
+    ''')
+    assert "WF220" not in _codes(lint.run_lint(cfg=cfg))
+
+
+def test_wf230_broad_except(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        def f():
+            try:
+                pass
+            except Exception:
+                return 1                 # swallowed: finding
+        def g():
+            try:
+                pass
+            except Exception:            # noqa: BLE001 — rationale given
+                return 2
+        def h():
+            try:
+                pass
+            except BaseException:
+                raise                    # cleanup re-raise: exempt
+        def i():
+            try:
+                pass
+            except ValueError:
+                return 4                 # concrete: fine
+        def j():
+            try:
+                pass
+            except Exception:            # noqa
+                return 5                 # bare noqa, no code: still a finding
+        def k():
+            try:
+                pass
+            except Exception:            # noqa: E501
+                return 6                 # unrelated code: still a finding
+    ''')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF230"]
+    assert len(hits) == 3 and all(x.severity == "warning" for x in hits)
+
+
+def test_baseline_counts_do_not_mask_new_duplicates(tmp_path):
+    """A baseline holding ONE `except Exception:` in a file must not also
+    suppress a newly added second with identical source text."""
+    cfg = _mini_repo(tmp_path, '''
+        def f():
+            try:
+                pass
+            except Exception:
+                return 1
+    ''')
+    one = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF230"]
+    bpath = tmp_path / "b.json"
+    lint.save_baseline(str(bpath), one)
+    cfg2 = _mini_repo(tmp_path / "dup", '''
+        def f():
+            try:
+                pass
+            except Exception:
+                return 1
+        def g():
+            try:
+                pass
+            except Exception:
+                return 1
+    ''')
+    two = [x for x in lint.run_lint(cfg=cfg2) if x.code == "WF230"]
+    assert len(two) == 2 and two[0].key() == two[1].key()
+    fresh = lint.apply_baseline(two, lint.load_baseline(str(bpath)))
+    assert len(fresh) == 1, "second identical violation must stay fresh"
+
+
+def test_wf240_unregistered_journal_event(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        from .observability import journal as _journal
+        def f():
+            _journal.record("good_event", x=1)
+            _journal.record("typo_event", x=1)
+    ''')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF240"]
+    assert len(hits) == 1 and "typo_event" in hits[0].message
+
+
+def test_wf241_unregistered_counter(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        from . import faults as _faults
+        def f():
+            _faults.bump("good_counter")
+            _faults.bump("typo_counter")
+    ''')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF241"]
+    assert len(hits) == 1 and "typo_counter" in hits[0].message
+
+
+def test_baseline_suppression_roundtrip(tmp_path):
+    cfg = _mini_repo(tmp_path, '''
+        def f():
+            try:
+                pass
+            except Exception:
+                return 1
+    ''')
+    findings = lint.run_lint(cfg=cfg)
+    assert "WF230" in _codes(findings)
+    bpath = tmp_path / "windflow_tpu" / "analysis" / "baseline.json"
+    lint.save_baseline(str(bpath), findings)
+    fresh = lint.apply_baseline(findings, lint.load_baseline(str(bpath)))
+    assert fresh == []
+    # a NEW finding (different source text) is not suppressed
+    cfg2 = _mini_repo(tmp_path / "n", '''
+        def g():
+            try:
+                pass
+            except BaseException:
+                return 9
+    ''')
+    findings2 = lint.run_lint(cfg=cfg2)
+    assert lint.apply_baseline(findings2, lint.load_baseline(str(bpath)))
+
+
+def test_env_override_baseline_path(tmp_path, monkeypatch):
+    """WF_LINT_BASELINE (docs/ENV_FLAGS.md) redirects the suppression set."""
+    alt = tmp_path / "alt_baseline.json"
+    monkeypatch.setenv("WF_LINT_BASELINE", str(alt))
+    cfg = lint.LintConfig(root=str(tmp_path))
+    assert lint.baseline_path(cfg) == str(alt)
+    monkeypatch.delenv("WF_LINT_BASELINE")
+    assert lint.baseline_path(cfg).endswith(
+        os.path.join("analysis", "baseline.json"))
+
+
+# ------------------------------------------------------------- CLI contract
+
+
+def _run_cli(*args, env=None):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "wf_lint.py"), *args],
+        capture_output=True, text=True, timeout=120, env=e)
+
+
+def test_cli_exit_0_on_clean_gate():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_exit_1_on_findings_and_json_format(tmp_path):
+    """A seeded violation → exit 1, --format=json machine-readable. (Pinned
+    against a fixture repo, NOT the live baseline debt — paying that debt
+    off must not break this contract test.)"""
+    _mini_repo(tmp_path, '''
+        def f():
+            try:
+                pass
+            except Exception:
+                return 1
+    ''')
+    proc = _run_cli("--format=json", "--no-baseline", "--root",
+                    str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert any(x["code"] == "WF230" for x in data["findings"])
+    assert {"code", "path", "line", "severity"} <= set(data["findings"][0])
+
+
+def test_cli_exit_2_on_internal_error(tmp_path):
+    """A root without the names registry breaks the linter itself → exit 2
+    (never confuse a broken gate with a clean one)."""
+    (tmp_path / "windflow_tpu").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ENV_FLAGS.md").write_text(_ENV_DOC)
+    proc = _run_cli("--root", str(tmp_path))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "internal error" in proc.stderr
+
+
+def test_cli_exit_2_on_missing_explicit_baseline(tmp_path):
+    """An explicit WF_LINT_BASELINE pointing nowhere is a broken gate (exit
+    2), not an empty baseline resurfacing old debt as 'fresh'."""
+    proc = _run_cli(env={"WF_LINT_BASELINE": str(tmp_path / "typo.json")})
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "missing baseline" in proc.stderr
+
+
+def test_cli_update_baseline_roundtrip(tmp_path):
+    """--update-baseline writes the current findings; the next gate run is
+    clean against it."""
+    bpath = tmp_path / "baseline.json"
+    proc = _run_cli("--update-baseline",
+                    env={"WF_LINT_BASELINE": str(bpath)})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(bpath.read_text())
+    assert isinstance(data["findings"], list)
+    proc2 = _run_cli(env={"WF_LINT_BASELINE": str(bpath)})
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr

@@ -49,5 +49,7 @@ from .runtime.builders import (Source_Builder, Filter_Builder, Map_Builder,
                                WinFarm_Builder, KeyFarm_Builder, KeyFFAT_Builder,
                                PaneFarm_Builder, WinMapReduce_Builder,
                                Sink_Builder, ReduceSink_Builder)
+from . import analysis
+from .analysis import validate as validate_graph
 
 __version__ = "0.1.0"

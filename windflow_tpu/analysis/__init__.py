@@ -1,0 +1,34 @@
+"""Static analysis — shift-left validation of graphs and framework invariants.
+
+Two pillars, both wired into tier-1 (``tests/test_analysis_validate.py``,
+``tests/test_lint_clean.py``) and usable standalone:
+
+- :func:`validate` (``analysis/validate.py``): flow abstract
+  ``jax.ShapeDtypeStruct`` specs through a built-but-not-run driver
+  (``PipeGraph``/``Pipeline``/``ThreadedPipeline``/``SupervisedPipeline``/
+  ``CompiledChain``) and check the run configuration (fault plans, governor
+  watermarks, admission control, prefetch) — typed ``WF1xx`` diagnostics with
+  operator paths and fix hints, before anything compiles or runs.
+- the invariant linter (``analysis/lint.py``): stdlib-``ast`` rules over
+  ``windflow_tpu/`` enforcing the codebase's cross-cutting contracts
+  (documented env reads, clock-free deterministic-replay modules,
+  lock-guarded attributes, no silent broad excepts, journal/metric names
+  registered centrally) — ``WF2xx`` findings gated against
+  ``analysis/baseline.json``. CLI: ``scripts/wf_lint.py``.
+
+The motivating stance is the GPU-portability literature's (arxiv 2306.11686,
+2601.17526): classify and validate programs against the execution model *up
+front* instead of discovering incompatibilities on the device — here, before
+a chain traces, a ring deadlocks, or a replay diverges.
+"""
+
+from .validate import (Diagnostic, ValidationError, ValidationReport,
+                       validate)
+from .lint import (Finding, LintConfig, apply_baseline, lint_repo,
+                   load_baseline, run_lint, save_baseline)
+
+__all__ = [
+    "validate", "ValidationReport", "ValidationError", "Diagnostic",
+    "run_lint", "lint_repo", "Finding", "LintConfig",
+    "load_baseline", "save_baseline", "apply_baseline",
+]

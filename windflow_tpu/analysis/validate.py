@@ -1,0 +1,602 @@
+"""Graph validator — Pillar 1 of the static-analysis layer.
+
+Flows abstract ``jax.ShapeDtypeStruct`` specs through every operator of a
+built (but not yet run) driver — ``PipeGraph`` / ``Pipeline`` /
+``ThreadedPipeline`` / ``SupervisedPipeline`` / a raw ``CompiledChain`` — via
+the operators' existing ``out_spec``/``eval_shape`` paths (``operators/
+filter.py``, ``win_seq.py``, ``sink.py``), and checks the run configuration
+(fault plans, governor watermarks, admission control, prefetch) against the
+invariants the runtime otherwise only enforces mid-stream.  Zero FLOPs, zero
+device access: everything happens at the abstract-spec level, so validation
+is safe on a CPU-only box for a graph destined for a TPU pod.
+
+Diagnostics carry stable codes (negative tests pin each one):
+
+====== ========= =====================================================
+code   severity  condition
+====== ========= =====================================================
+WF100  error     nothing to validate (graph without sources / empty)
+WF101  error     operator rejects its input payload spec (chained spec
+                 mismatch, bad split function, source spec failure)
+WF102  warning   operator introduces a weak-typed payload leaf (Python
+                 scalar promotion — a silent retrace hazard: the same
+                 chain retraces when a later caller passes a strongly-
+                 typed value)
+WF103  warn/err  fault-plan site unknown (error) or never threaded
+                 through the chosen driver (warning — the fault would
+                 silently never fire)
+WF104  warning   backpressure watermarks degenerate against an edge's
+                 ring capacity (resolved high >= capacity: throttle
+                 can only trigger on a completely full ring; resolved
+                 low >= high: the clamp forces low = high - 1)
+WF105  error     admission control illegal under supervision (wall-
+                 clock TokenBucket or a drop_oldest_ts holding cell —
+                 shed decisions would not replay deterministically)
+WF106  warning   prefetch depth exceeds the first ring's capacity
+                 (prefetched batches pile up behind a full ring; the
+                 governor's pause hook cannot help at that granularity)
+WF107  warning   dangling branch: a pipe with no sink, no in-graph
+                 ReduceSink, and no downstream edge — its output is
+                 silently discarded
+====== ========= =====================================================
+
+Usage::
+
+    from windflow_tpu.analysis import validate
+    report = validate(graph, faults=plan, control=cfg)
+    report.raise_if_errors()          # or: assert not report.errors
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+
+from ..batch import CTRL_DTYPE, TupleRef
+
+# ---------------------------------------------------------------- reporting
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One validator finding: stable code, severity, operator path, message,
+    and a fix hint (the shift-left counterpart of the runtime's mid-stream
+    stack trace)."""
+
+    code: str
+    severity: str            # "error" | "warning"
+    where: str               # operator path, e.g. "pipe[1].ops[2]:join"
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        s = f"{self.code} [{self.severity}] {self.where}: {self.message}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+class ValidationError(RuntimeError):
+    """Raised by :meth:`ValidationReport.raise_if_errors`; carries the
+    report as ``.report``."""
+
+    def __init__(self, report: "ValidationReport"):
+        super().__init__("graph validation failed:\n" + str(report))
+        self.report = report
+
+
+class ValidationReport:
+    """All diagnostics of one :func:`validate` run."""
+
+    def __init__(self, target: str):
+        self.target = target
+        self.diagnostics: List[Diagnostic] = []
+
+    def add(self, code: str, severity: str, where: str, message: str,
+            hint: str = "") -> None:
+        self.diagnostics.append(Diagnostic(code, severity, where, message,
+                                           hint))
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def codes(self) -> List[str]:
+        return [d.code for d in self.diagnostics]
+
+    def raise_if_errors(self) -> "ValidationReport":
+        if self.errors:
+            raise ValidationError(self)
+        return self
+
+    def to_json(self) -> dict:
+        return {"target": self.target,
+                "diagnostics": [dataclasses.asdict(d)
+                                for d in self.diagnostics]}
+
+    def __str__(self) -> str:
+        if not self.diagnostics:
+            return f"{self.target}: clean"
+        return "\n".join(d.render() for d in self.diagnostics)
+
+    __repr__ = __str__
+
+
+# ------------------------------------------------------------- spec flowing
+
+
+def _payload_fields(spec) -> str:
+    """Human rendering of a payload spec for WF101 hints."""
+    try:
+        leaves, treedef = jax.tree.flatten(spec)
+        shapes = ", ".join(f"{getattr(s, 'shape', '?')}:"
+                           f"{getattr(s, 'dtype', '?')}" for s in leaves)
+        return f"{treedef.unflatten(leaves)!r} ({shapes})"
+    except Exception:  # noqa: BLE001 — hint rendering must never mask WF101
+        return repr(spec)
+
+
+def _weak_leaves(spec) -> List[str]:
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(spec)[0]:
+        if getattr(leaf, "weak_type", False):
+            out.append(jax.tree_util.keystr(path) or "<leaf>")
+    return out
+
+
+def _check_weak(report, out_spec, in_spec, where: str) -> None:
+    """WF102 on NEWLY introduced weak leaves (upstream weakness was already
+    reported where it appeared)."""
+    new = _weak_leaves(out_spec)
+    if new and not _weak_leaves(in_spec):
+        report.add(
+            "WF102", "warning", where,
+            f"output payload leaf {', '.join(new)} is weakly typed (a "
+            f"Python-scalar result promoted by eval_shape)",
+            hint="return explicitly-dtyped arrays (jnp.asarray(x, "
+                 "jnp.float32) / .astype) — weak types make the compiled "
+                 "chain's signature depend on Python promotion rules, a "
+                 "silent retrace hazard")
+
+
+def _flow_ops(report, ops, in_spec, where_prefix: str,
+              in_capacity: Optional[int]):
+    """Flow ``in_spec`` through ``ops`` (binding geometry exactly as
+    ``CompiledChain.__init__`` would, so budget-dependent ``out_spec``s — TB
+    window archives — resolve). Returns ``(out_spec, out_capacity)``, both
+    None after a WF101 (downstream of a broken operator nothing is
+    knowable); capacity is None whenever ``in_capacity`` was."""
+    spec, cap = in_spec, in_capacity
+    for i, op in enumerate(ops):
+        where = f"{where_prefix}.ops[{i}]:{op.getName()}"
+        try:
+            if cap is not None:
+                op.bind_geometry(cap)
+                cap = op.out_capacity(cap)
+            out = op.out_spec(spec)
+        except Exception as e:  # noqa: BLE001 — diagnosis IS the product here
+            report.add(
+                "WF101", "error", where,
+                f"operator rejects its input payload spec: "
+                f"{type(e).__name__}: {e}",
+                hint=f"input payload spec here is {_payload_fields(spec)}; "
+                     f"the upstream operator's output must match what "
+                     f"{op.getName()!r}'s function destructures")
+            return None, None
+        _check_weak(report, out, spec, where)
+        spec = out
+    return spec, cap
+
+
+def _check_split(report, mp, out_spec, where: str) -> None:
+    t = TupleRef(key=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+                 id=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+                 ts=jax.ShapeDtypeStruct((), CTRL_DTYPE), data=out_spec)
+    n = len(mp.split_branches)
+    try:
+        sel = jax.eval_shape(mp.split_fn, t)
+    except Exception as e:  # noqa: BLE001 — diagnosis IS the product here
+        report.add("WF101", "error", f"{where}.split",
+                   f"split function rejects the pipe's output tuples: "
+                   f"{type(e).__name__}: {e}",
+                   hint=f"split fn receives TupleRef over payload "
+                        f"{_payload_fields(out_spec)}")
+        return
+    shape = getattr(sel, "shape", None)
+    if shape not in ((), (n,)):
+        report.add(
+            "WF101", "error", f"{where}.split",
+            f"split function returns shape {shape}, expected a scalar "
+            f"branch index or a multicast mask of shape ({n},) for "
+            f"{n} branches")
+
+
+def _has_reduce_sink(ops) -> bool:
+    from ..operators.sink import ReduceSink
+    return any(isinstance(op, ReduceSink) for op in ops)
+
+
+# --------------------------------------------------------- config checking
+
+
+#: fault-injection sites each driver actually threads (``runtime/threaded.py``
+#: fires per stage; the supervisors fire around steps + checkpoint I/O; the
+#: plain push drivers fire nothing)
+DRIVER_SITES = {
+    "pipeline": frozenset(),
+    "graph": frozenset(),
+    "graph-threaded": frozenset(),
+    "threaded": frozenset({"source.next", "queue.stall", "chain.step",
+                           "sink.consume"}),
+    "supervised": frozenset({"source.next", "chain.step", "sink.consume",
+                             "checkpoint.save", "checkpoint.load"}),
+}
+
+
+def _check_faults(report, faults, driver: str) -> None:
+    from ..runtime import faults as _faults
+    if faults is None:
+        try:
+            plan = _faults.FaultPlan.from_env()
+        except (ValueError, OSError) as e:
+            report.add("WF103", "error", "faults",
+                       f"WF_FAULT_PLAN does not parse: {e}")
+            return
+    elif isinstance(faults, _faults.FaultInjector):
+        plan = faults.plan
+    elif isinstance(faults, _faults.FaultPlan):
+        plan = faults
+    elif isinstance(faults, str):
+        try:
+            plan = _faults.FaultPlan.from_json(faults)
+        except (ValueError, KeyError, TypeError) as e:
+            report.add("WF103", "error", "faults",
+                       f"fault plan does not parse: {type(e).__name__}: {e}",
+                       hint="FaultPlan JSON is {\"seed\": n, \"faults\": "
+                            "[{\"site\": ..., ...}]}; sites: "
+                            + ", ".join(_faults.SITES))
+            return
+    else:
+        plan = None
+    if plan is None:
+        return
+    threaded = DRIVER_SITES.get(driver, frozenset())
+    for i, spec in enumerate(plan.faults):
+        if spec.site not in _faults.SITES:
+            report.add("WF103", "error", f"faults[{i}]",
+                       f"unknown fault site {spec.site!r} "
+                       f"(sites: {', '.join(_faults.SITES)})")
+        elif spec.site not in threaded:
+            fired = (", ".join(sorted(threaded)) or
+                     "(none — use the threaded or supervised drivers for "
+                     "injection)")
+            report.add(
+                "WF103", "warning", f"faults[{i}]",
+                f"fault site {spec.site!r} is never threaded through the "
+                f"{driver!r} driver — the spec can never fire",
+                hint=f"sites this driver fires: {fired}")
+
+
+def _check_watermarks(report, cfg, edges) -> None:
+    """``edges``: list of (label, capacity). Mirrors the resolution in
+    ``control/governor.py::watch`` — warn where the resolved thresholds
+    degenerate."""
+    if cfg is None or not cfg.backpressure:
+        return
+    for label, cap in edges:
+        hi = max(1, int(cap * cfg.high_watermark))
+        lo_raw = int(cap * cfg.low_watermark)
+        if hi >= cap:
+            report.add(
+                "WF104", "warning", f"edge[{label}]",
+                f"resolved high watermark {hi} >= ring capacity {cap} "
+                f"(high_watermark={cfg.high_watermark}): the governor can "
+                f"only throttle once the ring is completely full, i.e. "
+                f"after the producer already blocked inside push",
+                hint="raise queue_capacity for this edge (capacity >= 2 "
+                     "gives the watermark headroom) or lower high_watermark")
+        elif lo_raw >= hi:
+            report.add(
+                "WF104", "warning", f"edge[{label}]",
+                f"resolved low watermark {lo_raw} >= high watermark {hi} "
+                f"on capacity {cap}; the runtime clamps low to {hi - 1}, "
+                f"so the throttle releases after a single pop",
+                hint="widen the high/low fraction gap or raise the edge's "
+                     "queue_capacity so the fractions resolve distinctly")
+
+
+def _check_admission(report, cfg, supervised: bool, where: str) -> None:
+    if cfg is None or not cfg.admission:
+        return
+    if not supervised:
+        return
+    if cfg.refill_per_batch is None:
+        report.add(
+            "WF105", "error", where,
+            "admission control under supervision uses the wall-clock "
+            "TokenBucket (rate_tps) — a restore changes the refill "
+            "timeline, so replayed shed decisions diverge from the "
+            "original run and exactly-once delivery breaks",
+            hint="use ControlConfig(refill_per_batch=...) — the positional "
+                 "bucket makes shedding a pure function of stream position, "
+                 "which the supervisor snapshots and restores")
+    if cfg.shed_policy != "drop_newest":
+        report.add(
+            "WF105", "error", where,
+            f"admission shed_policy={cfg.shed_policy!r} under supervision: "
+            f"a drop_oldest_ts holding cell would have to be serialized "
+            f"into every checkpoint",
+            hint="supervised drivers support shed_policy='drop_newest' only")
+
+
+def _check_prefetch(report, prefetch: int, first_edge) -> None:
+    if not prefetch or first_edge is None:
+        return
+    label, cap = first_edge
+    if prefetch > cap:
+        report.add(
+            "WF106", "warning", f"edge[{label}]",
+            f"prefetch depth {prefetch} exceeds the first ring's capacity "
+            f"{cap}: up to {prefetch - cap} prefetched (H2D-transferred) "
+            f"batches pile up behind a full ring where the governor's "
+            f"pause hook cannot reach them",
+            hint="size prefetch <= the src edge's queue_capacity")
+
+
+def _resolve_control(explicit, stored):
+    from ..control import ControlConfig
+    if explicit is not None:
+        return ControlConfig.resolve(explicit)
+    return stored
+
+
+# -------------------------------------------------------------- validators
+
+
+def _source_spec(report, source, where: str) -> Optional[Any]:
+    """Source ``payload_spec()`` with the WF101/WF102 checks — the one
+    implementation every driver validator goes through. None on failure."""
+    try:
+        spec = source.payload_spec()
+    except Exception as e:  # noqa: BLE001 — diagnosis IS the product here
+        report.add("WF101", "error", where,
+                   f"source payload_spec() fails: {type(e).__name__}: {e}")
+        return None
+    weak = _weak_leaves(spec)
+    if weak:
+        report.add("WF102", "warning", where,
+                   f"source payload leaf {', '.join(weak)} is weakly typed",
+                   hint="emit explicitly-dtyped payloads from the source")
+    return spec
+
+
+def _validate_chain_ops(report, ops, in_spec, in_cap, where: str,
+                        sink=None) -> Optional[Any]:
+    out, _cap = _flow_ops(report, ops, in_spec, where, in_cap)
+    if sink is None and not _has_reduce_sink(ops):
+        report.add(
+            "WF107", "warning", where,
+            "no sink and no in-graph ReduceSink: every output batch is "
+            "computed, transferred, and discarded",
+            hint="add a Sink/ReduceSink, or drop the dead tail of the chain")
+    return out
+
+
+def _validate_pipeline(report, p, faults, control, supervised) -> None:
+    cfg = _resolve_control(control, getattr(p, "_control", None))
+    in_spec = _source_spec(report, p.source, f"source:{p.source.getName()}")
+    if in_spec is None:
+        return
+    # the chain's operators were geometry-bound at construction — flow the
+    # specs only (binding again with a validator-chosen capacity could skew
+    # budget-derived archive sizes)
+    _validate_chain_ops(report, p.chain.ops, in_spec, None, "pipeline",
+                        sink=p.sink)
+    _check_faults(report, faults, "supervised" if supervised else "pipeline")
+    _check_admission(report, cfg, supervised, "control.admission")
+
+
+def _validate_supervised(report, sp, faults, control) -> None:
+    cfg = _resolve_control(control, getattr(sp, "_control", None))
+    in_spec = _source_spec(report, sp.source,
+                           f"source:{sp.source.getName()}")
+    if in_spec is None:
+        return
+    _validate_chain_ops(report, sp.chain.ops, in_spec, None, "supervised",
+                        sink=sp.sink)
+    _check_faults(report, faults if faults is not None
+                  else getattr(sp, "_faults_arg", None), "supervised")
+    _check_admission(report, cfg, True, "control.admission")
+
+
+def _validate_threaded(report, tp, faults, control, supervised) -> None:
+    cfg = _resolve_control(control, getattr(tp, "_control", None))
+    spec = _source_spec(report, tp.source,
+                        f"source:{tp.source.getName()}")
+    if spec is None:
+        return
+    for i, chain in enumerate(tp.chains):
+        # capacity None: segment chains were geometry-bound at construction
+        spec, _cap = _flow_ops(report, chain.ops, spec, f"seg{i}", None)
+        if spec is None:
+            break
+    if tp.sink is None and not any(_has_reduce_sink(c.ops)
+                                   for c in tp.chains):
+        report.add("WF107", "warning", "threaded",
+                   "no sink and no in-graph ReduceSink: the final ring's "
+                   "batches are popped and discarded",
+                   hint="add a Sink/ReduceSink, or drop the dead tail")
+    edges = [(name, tp.edge_capacities[name]) for name in tp.edge_names]
+    _check_watermarks(report, cfg, edges)
+    _check_prefetch(report, getattr(tp, "prefetch", 0),
+                    edges[0] if edges else None)
+    _check_faults(report, faults if faults is not None
+                  else getattr(tp, "_faults_arg", None), "threaded")
+    _check_admission(report, cfg, supervised, "control.admission")
+
+
+def _graph_edges(g):
+    """(label, capacity) per dataflow edge — resolved over the SAME
+    enumeration the threaded driver builds rings from
+    (``PipeGraph._iter_edges``), so the checks can never drift onto edges
+    the driver does not create."""
+    from ..runtime.threaded import _resolve_edge_capacity
+    return [(label, _resolve_edge_capacity(g.queue_capacity, label, index))
+            for _prod, _dst, label, index in g._iter_edges()]
+
+
+def _check_graph_edges(report, g, cfg) -> None:
+    """Resolve every threaded-driver edge capacity the way the driver will —
+    an illegal per-edge capacity (<1, bad dict/callable) is a WF104 error
+    *now* instead of a ValueError mid-``run(threaded=True)``."""
+    try:
+        edges = _graph_edges(g)
+    except Exception as e:  # noqa: BLE001 — diagnosis IS the product here
+        report.add("WF104", "error", "queue_capacity",
+                   f"edge capacity resolution fails: "
+                   f"{type(e).__name__}: {e}",
+                   hint="queue_capacity must resolve every edge to an int "
+                        ">= 1 (one int, a dict keyed by edge label/index, "
+                        "or a callable (label, index) -> int)")
+        return
+    _check_watermarks(report, cfg, edges)
+
+
+def _validate_graph(report, g, faults, control, supervised,
+                    threaded) -> None:
+    from ..basic import DEFAULT_BATCH_SIZE
+    from ..control import ControlConfig
+    from ..runtime.pipeline import resolve_batch_hint
+    if not g._roots:
+        report.add("WF100", "error", "graph",
+                   "PipeGraph has no sources — nothing will run",
+                   hint="add_source(...) before validating/running")
+        return
+    stored = g._control
+    if stored is None:
+        stored = ControlConfig.resolve(g._control_arg)
+    cfg = _resolve_control(control, stored)
+    batch = (g.batch_size if g.batch_size is not None
+             else (resolve_batch_hint(g._operators) or DEFAULT_BATCH_SIZE))
+    pipes = g._all_pipes()
+    pipe_idx = {id(p): i for i, p in enumerate(pipes)}
+    out_specs, out_caps = {}, {}
+    for mp in g._topo_order():
+        where = f"pipe[{pipe_idx[id(mp)]}]"
+        if mp.source is not None:
+            in_spec = _source_spec(
+                report, mp.source,
+                f"{where}.source:{mp.source.getName()}")
+            if in_spec is None:
+                continue
+            in_cap = getattr(mp.source, "out_capacity",
+                             lambda b: b)(batch)
+        elif mp.merge_inputs:
+            specs = [out_specs.get(id(p)) for p in mp.merge_inputs]
+            if any(s is None for s in specs):
+                continue               # upstream already diagnosed
+            in_spec = specs[0]         # merge() checked compatibility
+            in_cap = batch             # merged releases re-chunk to batch
+        else:
+            parent = mp._dataflow_parent
+            in_spec = out_specs.get(id(parent))
+            in_cap = out_caps.get(id(parent))
+            if in_spec is None:
+                continue               # upstream already diagnosed
+        out, out_cap = _flow_ops(report, mp.ops, in_spec, where, in_cap)
+        out_specs[id(mp)] = out
+        if out_cap is not None:
+            out_caps[id(mp)] = out_cap
+        if mp.split_fn is not None and out is not None:
+            _check_split(report, mp, out, where)
+        if (mp.sink is None and not mp.split_branches
+                and not mp._outputs_to and mp.split_fn is None
+                and not _has_reduce_sink(mp.ops)):
+            report.add(
+                "WF107", "warning", where,
+                "leaf pipe has no sink, no in-graph ReduceSink, and no "
+                "downstream edge — its output batches are discarded",
+                hint="add a sink to this branch (or merge it into a pipe "
+                     "that has one)")
+    if threaded:
+        # ring edges exist only under run(threaded=True) — the push driver
+        # never resolves queue_capacity, so these checks would be spurious
+        _check_graph_edges(report, g, cfg)
+    driver = ("supervised" if supervised
+              else ("graph-threaded" if threaded else "graph"))
+    _check_faults(report, faults, driver)
+    _check_admission(report, cfg, supervised, "control.admission")
+
+
+def _validate_compiled_chain(report, chain, faults, control,
+                             supervised) -> None:
+    _flow_ops(report, chain.ops, chain.specs[0], "chain", None)
+    _check_faults(report, faults, "supervised" if supervised else "pipeline")
+    from ..control import ControlConfig
+    _check_admission(report, ControlConfig.resolve(control)
+                     if control is not None else None,
+                     supervised, "control.admission")
+
+
+# ------------------------------------------------------------------ public
+
+
+def validate(obj, *, faults=None, control=None, supervised: bool = None,
+             threaded: bool = False) -> ValidationReport:
+    """Validate a built-but-not-run driver object; returns a
+    :class:`ValidationReport` (never raises on findings — call
+    ``.raise_if_errors()`` to gate).
+
+    ``obj``: a ``PipeGraph``, ``Pipeline``, ``ThreadedPipeline``,
+    ``SupervisedPipeline``, or raw ``CompiledChain``.
+
+    ``faults``: a ``FaultPlan``/``FaultInjector``/JSON string to check
+    against the sites the chosen driver actually threads; ``None`` consults
+    ``WF_FAULT_PLAN`` (mirroring the drivers).
+
+    ``control``: a ``ControlConfig``/dict/bool overriding the object's own
+    stored control config for the configuration checks.
+
+    ``supervised``: declare that the object will run under supervision
+    (``run_supervised`` / ``run_graph_supervised``); inferred True for a
+    ``SupervisedPipeline``. ``threaded``: a ``PipeGraph`` destined for
+    ``run(threaded=True)`` (enables the ring-edge checks)."""
+    from ..runtime.pipegraph import PipeGraph
+    from ..runtime.pipeline import CompiledChain, Pipeline
+    from ..runtime.supervisor import SupervisedPipeline
+    from ..runtime.threaded import ThreadedPipeline
+
+    if isinstance(obj, PipeGraph):
+        report = ValidationReport(f"PipeGraph({obj.name!r})")
+        _validate_graph(report, obj, faults, control, bool(supervised),
+                        threaded)
+    elif isinstance(obj, SupervisedPipeline):
+        report = ValidationReport("SupervisedPipeline")
+        _validate_supervised(report, obj, faults, control)
+    elif isinstance(obj, ThreadedPipeline):
+        report = ValidationReport("ThreadedPipeline")
+        _validate_threaded(report, obj, faults, control, bool(supervised))
+    elif isinstance(obj, Pipeline):
+        report = ValidationReport("Pipeline")
+        _validate_pipeline(report, obj, faults, control, bool(supervised))
+    elif isinstance(obj, CompiledChain):
+        report = ValidationReport("CompiledChain")
+        _validate_compiled_chain(report, obj, faults, control,
+                                 bool(supervised))
+    else:
+        report = ValidationReport(type(obj).__name__)
+        report.add("WF100", "error", "target",
+                   f"cannot validate a {type(obj).__name__}; expected "
+                   f"PipeGraph, Pipeline, ThreadedPipeline, "
+                   f"SupervisedPipeline, or CompiledChain")
+    return report

@@ -14,11 +14,11 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-_COUNTER_NAMES = (
-    "admitted_batches", "admitted_tuples", "shed_batches", "shed_tuples",
-    "throttle_events", "throttle_seconds", "capacity_switches",
-    "tuning_decisions", "tuning_cache_hits",
-)
+from ..observability.names import CONTROL_COUNTERS
+
+#: canonical counter names live in the observability registry so the static
+#: linter can check every ``bump("...")`` call site against one source of truth
+_COUNTER_NAMES = CONTROL_COUNTERS
 
 _counters: Dict[str, float] = {k: 0 for k in _COUNTER_NAMES}
 _gauges: Dict[str, float] = {}

@@ -45,7 +45,10 @@ class TokenBucket:
     """Wall-clock token bucket: ``rate`` tokens/second, capacity ``burst``.
     ``clock`` is injectable (fake clocks in tests)."""
 
-    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+    # the wall-clock flavour is EXPLICITLY non-replayable — the supervised
+    # drivers reject it (_supervised_admission); live drivers only
+    def __init__(self, rate: float, burst: float,
+                 clock=time.monotonic):      # wf-lint: allow[wall-clock]
         self.rate = float(rate)
         self.burst = float(burst)
         self.clock = clock
@@ -125,7 +128,7 @@ class AdmissionController:
         self.policy = policy
         self.hold_max = max(0, int(hold_max))
         self.driver = driver
-        self.held: deque = deque()
+        self.held: deque = deque()           # wf-lint: guarded-by[_lock]
         self.admitted = 0                     # batches (per-controller, tests)
         self.shed = 0
         #: pass one shared lock to controllers sharing one bucket (a graph
@@ -213,9 +216,12 @@ def resolve_burst(cfg, base_capacity: int) -> float:
                float(base_capacity))
 
 
-def bucket_from_config(cfg, base_capacity: int, clock=time.monotonic):
+def bucket_from_config(cfg, base_capacity: int,
+                       clock=time.monotonic):  # wf-lint: allow[wall-clock]
     """The bucket a ``ControlConfig`` asks for (None when admission is off or
-    rate-unlimited)."""
+    rate-unlimited). The wall-clock default only ever reaches the live
+    drivers — the supervised path requires ``refill_per_batch`` and builds a
+    clock-free :class:`PositionBucket`."""
     if cfg is None or not cfg.admission:
         return None
     burst = resolve_burst(cfg, base_capacity)
@@ -227,7 +233,7 @@ def bucket_from_config(cfg, base_capacity: int, clock=time.monotonic):
 
 
 def admission_from_config(cfg, base_capacity: int, *, driver: str = "",
-                          clock=time.monotonic,
+                          clock=time.monotonic,  # wf-lint: allow[wall-clock]
                           ) -> Optional[AdmissionController]:
     """One controller over its own bucket (single-source drivers)."""
     bucket = bucket_from_config(cfg, base_capacity, clock=clock)
@@ -238,7 +244,8 @@ def admission_from_config(cfg, base_capacity: int, *, driver: str = "",
 
 
 def admission_group(cfg, base_capacity: int, n: int, *, driver: str = "",
-                    clock=time.monotonic) -> List[Optional[AdmissionController]]:
+                    clock=time.monotonic,    # wf-lint: allow[wall-clock]
+                    ) -> List[Optional[AdmissionController]]:
     """``n`` controllers sharing ONE bucket (and one lock): a multi-source
     graph rate-limits *total* ingest while each source keeps its own holding
     cell, so held batches always re-enter their own source's stream."""

@@ -33,6 +33,8 @@ from typing import Optional, Union
 
 from .journal import EventJournal, read_journal, set_active as set_journal
 from .metrics import LogHistogram, MetricsRegistry
+from .names import (CONTROL_COUNTERS, CONTROL_GAUGES, JOURNAL_EVENTS,
+                    RECOVERY_COUNTERS)
 from .reporter import Reporter
 from .topology import (graph_topology_dot, graph_topology_json,
                        pipeline_topology_dot, pipeline_topology_json,

@@ -148,7 +148,8 @@ class MetricsRegistry:
         self._gauges: Dict[str, Callable[[], Any]] = {}
         self._queue_gauges: Dict[str, Callable[[], int]] = {}
         self._queue_capacities: Dict[str, int] = {}
-        self._prev: Dict[int, tuple] = {}    # id(op) -> (t, inputs, outputs)
+        # id(op) -> (t, inputs, outputs)  # wf-lint: guarded-by[_lock]
+        self._prev: Dict[int, tuple] = {}
         self._lock = threading.Lock()
 
     # -- registration -----------------------------------------------------------------
@@ -231,10 +232,17 @@ class MetricsRegistry:
                 or state is None
                 or not hasattr(state, "wm") or not hasattr(state, "next_win")):
             return None
+        import jax.errors
         try:
             wm = int(np.max(np.asarray(state.wm)))
             nxt = int(np.max(np.asarray(state.next_win)))
-        except Exception:       # noqa: BLE001 — donated/abstract state mid-run
+        except (RuntimeError, jax.errors.JAXTypeError):
+            # the concrete failure modes of reading live window state
+            # mid-run: a donated/deleted buffer materializes as RuntimeError
+            # ("Array has been deleted"), an abstract value (snapshot racing
+            # a trace) as TracerArrayConversionError/ConcretizationTypeError
+            # (both JAXTypeError) — anything else is a bug that should
+            # surface, not be swallowed
             return None
         frontier = nxt * spec.slide
         return {"watermark_ts": wm, "fire_frontier_ts": frontier,

@@ -1,0 +1,70 @@
+"""Central registries of journal event names and metric/counter names.
+
+THE single source of truth for every name the runtime emits into the
+observability plane: journal events/spans (``journal.record``/``journal.span``),
+process-wide recovery counters (``runtime.faults.bump``), control-plane
+counters/gauges (``control._state.bump``/``set_gauge``).  The emitting modules
+import their name tables from here, and the static-analysis linter
+(``windflow_tpu/analysis/lint.py``) checks every emission call site against
+these registries — a typo'd event name (``"chekpoint"``) or an undeclared
+counter fails tier-1 instead of silently forking the metric namespace.
+
+Pure data, stdlib only, imported by ``runtime``/``control``/``analysis`` —
+this module must never import anything from the package (the linter parses it
+with ``ast`` so it can run without JAX installed).
+
+Adding a name: add it here AND emit it — the linter flags emissions missing
+from the registry; an unused registry entry is harmless (names outlive call
+sites across refactors).
+"""
+
+from __future__ import annotations
+
+#: every journal event name emitted via ``journal.record``/``EventJournal.
+#: event`` and every span name opened via ``journal.span`` (spans appear as
+#: ``phase=begin/end`` pairs under the same name)
+JOURNAL_EVENTS = (
+    # observability lifecycle (observability/__init__.py Monitor)
+    "monitoring_start", "monitoring_end",
+    # compiled-chain hot path (runtime/pipeline.py, sampled)
+    "launch",
+    # EOS protocol (runtime/pipeline.py, runtime/pipegraph.py)
+    "eos", "eos_flush", "eos_propagate",
+    # ordering buffer (parallel/ordering.py, via its _journal_release wrapper)
+    "ordering_flush", "ordering_close_channel",
+    # supervision / recovery (runtime/supervisor.py, runtime/faults.py,
+    # runtime/checkpoint.py, runtime/threaded.py)
+    "checkpoint", "restore",                       # spans
+    "checkpoint_invalid", "checkpoint_fallback",
+    "restart_exhausted", "dead_letter", "backoff",
+    "watchdog_timeout", "watchdog_stale",
+    "fault_injected",
+    # control plane (control/admission.py, control/governor.py,
+    # control/autotune.py, runtime/supervisor.py warm start)
+    "shed", "throttle", "throttle_end",
+    "capacity_switch", "tuning_converged", "tuning_warm_start",
+)
+
+#: process-wide recovery counters (``runtime/faults.py``; surfaced in the
+#: metrics snapshot under ``"recovery"`` and in Prometheus as
+#: ``windflow_recovery_<name>_total``)
+RECOVERY_COUNTERS = (
+    "restarts", "backoff_sleeps", "backoff_seconds",
+    "dead_letters", "watchdog_timeouts", "faults_injected",
+    "checkpoint_saves", "checkpoint_corrupt_skipped",
+    "checkpoint_fallbacks",
+)
+
+#: process-wide control-plane counters (``control/_state.py``; snapshot
+#: ``"control"`` section, Prometheus ``windflow_control_<name>_total``)
+CONTROL_COUNTERS = (
+    "admitted_batches", "admitted_tuples", "shed_batches", "shed_tuples",
+    "throttle_events", "throttle_seconds", "capacity_switches",
+    "tuning_decisions", "tuning_cache_hits",
+)
+
+#: control-plane gauges (``control/_state.py::set_gauge``; Prometheus
+#: ``windflow_control_<name>``)
+CONTROL_GAUGES = (
+    "chosen_capacity",
+)

@@ -210,7 +210,9 @@ def save_chain(chain: CompiledChain, path: str, *, meta: dict = None,
     _atomic_write_bytes(file, raw)
     entries.append({"file": os.path.basename(file), "seq": seq,
                     "sha256": hashlib.sha256(raw).hexdigest(),
-                    "wall": time.time(),
+                    # lineage metadata only — never read back on the replay
+                    # path, so a wall timestamp cannot skew recovery
+                    "wall": time.time(),      # wf-lint: allow[wall-clock]
                     "meta": {k: v for k, v in (meta or {}).items()}})
     while len(entries) > keep:
         old = entries.pop(0)

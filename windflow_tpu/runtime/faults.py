@@ -40,6 +40,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..observability import journal as _journal
+from ..observability.names import RECOVERY_COUNTERS
 
 #: the named injection sites threaded through the runtime drivers
 SITES = ("source.next", "chain.step", "sink.consume",
@@ -266,10 +267,9 @@ def decision(site: str, **ctx) -> Optional[FaultSpec]:
 
 # --------------------------------------------------------- recovery counters
 
-_COUNTER_NAMES = ("restarts", "backoff_sleeps", "backoff_seconds",
-                  "dead_letters", "watchdog_timeouts", "faults_injected",
-                  "checkpoint_saves", "checkpoint_corrupt_skipped",
-                  "checkpoint_fallbacks")
+#: canonical counter names live in the observability registry so the static
+#: linter can check every ``bump("...")`` call site against one source of truth
+_COUNTER_NAMES = RECOVERY_COUNTERS
 _counters: Dict[str, float] = {k: 0 for k in _COUNTER_NAMES}
 _counters_lock = threading.Lock()
 
@@ -408,7 +408,7 @@ class DeadLetterQueue:
                  max_entries: int = 1024):
         self.spill_path = spill_path
         self.max_entries = int(max_entries)
-        self.entries: List[dict] = []
+        self.entries: List[dict] = []      # wf-lint: guarded-by[_lock]
         self.dropped = 0                   # entries evicted past max_entries
         self._lock = threading.Lock()
 

@@ -352,6 +352,27 @@ class PipeGraph:
 
     # -- threaded driver --------------------------------------------------------------
 
+    def _iter_edges(self):
+        """Dataflow edges of the threaded driver, in ring-creation order:
+        yields ``(producer, consumer, label, index)`` — ``producer`` None for
+        source-ingest edges. THE single enumeration, consumed by
+        ``_run_threaded`` (ring creation) and ``analysis.validate`` (pre-run
+        capacity/watermark checks) — edge labels are minted nowhere else, so
+        the validator can never check rings the driver does not build."""
+        pipes = self._all_pipes()
+        pipe_idx = {id(p): i for i, p in enumerate(pipes)}
+        n = 0
+        for p in pipes:
+            if p.source is not None:
+                yield None, p, f"src->{pipe_idx[id(p)]}", n
+                n += 1
+            for b in p.split_branches:
+                yield p, b, f"{pipe_idx[id(p)]}->{pipe_idx[id(b)]}", n
+                n += 1
+            for m in p._outputs_to:
+                yield p, m, f"{pipe_idx[id(p)]}->{pipe_idx[id(m)]}", n
+                n += 1
+
     def _run_threaded(self):
         import threading
         from ..native import SPSCQueue
@@ -368,17 +389,12 @@ class PipeGraph:
         from ..control import governor_from_config
         governor = governor_from_config(self._control)
         admissions = self._make_admissions("graph-threaded")
-        edge_count = [0]
 
-        def add_edge(src_id, dst):
-            label = (f"src->{pipe_idx[id(dst)]}" if src_id == "src"
-                     else f"{pipe_idx[src_id]}->{pipe_idx[id(dst)]}")
-            cap = _resolve_edge_capacity(self.queue_capacity, label,
-                                         edge_count[0])
-            edge_count[0] += 1
+        for prod, dst, label, index in self._iter_edges():
+            cap = _resolve_edge_capacity(self.queue_capacity, label, index)
             q = SPSCQueue(cap)
             in_queues[id(dst)].append(q)
-            out_edges[(src_id, id(dst))] = q
+            out_edges[("src" if prod is None else id(prod), id(dst))] = q
             if self._monitor is not None:
                 # live ring-depth gauge per dataflow edge: depth near capacity
                 # = backpressure, the consumer pipe is the bottleneck
@@ -386,16 +402,8 @@ class PipeGraph:
                                                           capacity=cap)
             if governor is not None:
                 governor.watch(label, q.size, cap)
-            return q
-
-        for p in pipes:
-            if p.source is not None:
-                add_edge("src", p)
-            for b in p.split_branches:
-                add_edge(id(p), b)
-            for m in p._outputs_to:
-                q = add_edge(id(p), m)
-                channel_of[id(q)] = m.merge_inputs.index(p)
+            if prod is not None and dst.merge_inputs:
+                channel_of[id(q)] = dst.merge_inputs.index(prod)
         errors = []
 
         def deliver(mp, out):
