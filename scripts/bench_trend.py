@@ -1,0 +1,215 @@
+#!/usr/bin/env python3
+"""bench_trend — the bench trajectory across rounds, as a markdown table.
+
+Each builder round leaves ``BENCH_r<NN>.json`` (single-chip ``bench.py`` run:
+``rc``, ``tail``, and — when the run parsed — a ``parsed`` metric record) and
+``MULTICHIP_r<NN>.json`` (8-device smoke: ``rc``/``ok``) in the repo root.
+The trajectory across those rounds is otherwise invisible; this tool folds
+them into one trend table with regression flags:
+
+- **ok**       — parsed metric present, within threshold of the best round
+                 so far (the regression reference is *best-so-far*, not the
+                 previous round, so a slow drift cannot ratchet the bar down)
+- **BEST**     — a new best value
+- **REGRESSED**— value below ``(1 - threshold) * best_so_far``
+- **STALE**    — the round emitted a last-good capture marked ``stale``
+                 (device unreachable at capture time): reported, but it
+                 neither sets nor regresses against the best
+- **FAILED**   — ``rc != 0`` or no parsed metric: the round produced *no*
+                 measurement.  Reported loudly (with the rc and the tail's
+                 last line), never skipped — an invisible failed round reads
+                 as "no regression" when the truth is "no data".
+
+Stdlib only.  Usage::
+
+    python scripts/bench_trend.py                   # repo root, markdown
+    python scripts/bench_trend.py --threshold 0.10 --out TREND.md
+
+Exit codes: 0 = no regressions among measured rounds, 1 = at least one
+REGRESSED round, 2 = no round files found / unreadable input.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_no(path: str):
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _last_line(tail: str) -> str:
+    lines = [ln.strip() for ln in (tail or "").splitlines() if ln.strip()]
+    return lines[-1] if lines else ""
+
+
+def load_rounds(root: str, prefix: str):
+    """Sorted (round, data) pairs for ``<prefix>_r*.json`` under ``root``."""
+    out = []
+    for path in glob.glob(os.path.join(root, f"{prefix}_r*.json")):
+        n = _round_no(path)
+        if n is None:
+            continue
+        with open(path) as f:
+            out.append((n, json.load(f)))
+    return sorted(out, key=lambda x: x[0])
+
+
+def bench_rows(rounds, threshold: float):
+    """One row dict per bench round: the ``parsed`` metric vs best-so-far."""
+    rows, best = [], None
+    for n, d in rounds:
+        parsed = d.get("parsed")
+        rc = d.get("rc")
+        row = {"round": n, "rc": rc, "value": None, "unit": "",
+               "vs_baseline": None, "stale": False, "status": "",
+               "note": ""}
+        if parsed is None or rc not in (0, None):
+            # rc=1/parsed=null rounds MUST surface — a silent skip would
+            # render the failed round as "nothing happened"
+            row["status"] = "FAILED"
+            row["note"] = (f"rc={rc}, no parsed metric"
+                           + (f" — {_last_line(d.get('tail', ''))[:80]}"
+                              if d.get("tail") else ""))
+            rows.append(row)
+            continue
+        value = parsed.get("value")
+        row.update(value=value, unit=parsed.get("unit", ""),
+                   vs_baseline=parsed.get("vs_baseline"),
+                   stale=bool(parsed.get("stale")))
+        if value is None:
+            row["status"] = "FAILED"
+            row["note"] = "parsed record without a value"
+        elif row["stale"]:
+            # a re-emitted last-good capture is not a fresh measurement:
+            # report it, keep it out of the best-so-far comparison
+            row["status"] = "STALE"
+            row["note"] = parsed.get("staleness_reason", "stale capture")
+        elif best is None or value > best:
+            row["status"] = "BEST"
+            best = value
+        elif value < (1.0 - threshold) * best:
+            row["status"] = "REGRESSED"
+            row["note"] = (f"{(1.0 - value / best) * 100.0:.1f}% below "
+                           f"best-so-far {best:g}")
+        else:
+            row["status"] = "ok"
+        rows.append(row)
+    return rows
+
+
+def multichip_rows(rounds):
+    rows = []
+    for n, d in rounds:
+        rc, ok = d.get("rc"), d.get("ok")
+        row = {"round": n, "rc": rc, "devices": d.get("n_devices"),
+               "status": "ok" if ok else "FAILED", "note": ""}
+        if d.get("skipped"):
+            row["status"], row["note"] = "SKIPPED", "no multi-device run"
+        elif not ok:
+            row["note"] = (f"rc={rc}"
+                           + (" (timeout)" if rc == 124 else "")
+                           + (f" — {_last_line(d.get('tail', ''))[:80]}"
+                              if d.get("tail") else ""))
+        rows.append(row)
+    return rows
+
+
+def _cell(s) -> str:
+    """A tail excerpt with '|' in it must not break the table."""
+    return str(s).replace("|", "\\|")
+
+
+def _fmt(v):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:g}"
+    if isinstance(v, int) and abs(v) >= 1_000_000:
+        return f"{v / 1e6:.2f}M"
+    return str(v)
+
+
+def render_markdown(bench, multichip, threshold: float) -> str:
+    lines = ["# Bench trend", ""]
+    lines.append(f"Regression flag: value < (1 - {threshold:g}) x "
+                 f"best-so-far among fresh (non-stale) measured rounds.")
+    lines.append("")
+    lines.append("## Single-chip (`BENCH_r*.json`, `parsed` metric)")
+    lines.append("")
+    lines.append("| round | status | value | unit | vs baseline | note |")
+    lines.append("|---|---|---|---|---|---|")
+    for r in bench:
+        lines.append(f"| r{r['round']:02d} | {r['status']} "
+                     f"| {_fmt(r['value'])} | {r['unit'] or '—'} "
+                     f"| {_fmt(r['vs_baseline'])} | {_cell(r['note'] or '')} |")
+    if not bench:
+        lines.append("| — | — | — | — | — | no BENCH_r*.json found |")
+    lines.append("")
+    lines.append("## Multi-chip smoke (`MULTICHIP_r*.json`)")
+    lines.append("")
+    lines.append("| round | status | devices | note |")
+    lines.append("|---|---|---|---|")
+    for r in multichip:
+        lines.append(f"| r{r['round']:02d} | {r['status']} "
+                     f"| {r['devices'] if r['devices'] is not None else '—'} "
+                     f"| {_cell(r['note'] or '')} |")
+    if not multichip:
+        lines.append("| — | — | — | no MULTICHIP_r*.json found |")
+    lines.append("")
+    n_fail = sum(1 for r in bench + multichip if r["status"] == "FAILED")
+    n_reg = sum(1 for r in bench if r["status"] == "REGRESSED")
+    n_stale = sum(1 for r in bench if r["status"] == "STALE")
+    lines.append(f"{len(bench)} bench round(s): {n_reg} regressed, "
+                 f"{n_stale} stale, "
+                 f"{sum(1 for r in bench if r['status'] == 'FAILED')} failed; "
+                 f"{len(multichip)} multichip round(s), "
+                 f"{n_fail} failed total.")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_trend",
+        description="fold BENCH_r*/MULTICHIP_r* rounds into a markdown "
+                    "trend table with regression flags")
+    ap.add_argument("--root", default=REPO,
+                    help="directory holding the round files (default: repo)")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="regression threshold vs best-so-far (default 0.05)")
+    ap.add_argument("--out", default=None,
+                    help="write the markdown here instead of stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        bench = load_rounds(args.root, "BENCH")
+        multichip = load_rounds(args.root, "MULTICHIP")
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_trend: unreadable round file: {e}", file=sys.stderr)
+        return 2
+    if not bench and not multichip:
+        print(f"bench_trend: no BENCH_r*.json / MULTICHIP_r*.json under "
+              f"{args.root!r}", file=sys.stderr)
+        return 2
+    brows = bench_rows(bench, args.threshold)
+    mrows = multichip_rows(multichip)
+    md = render_markdown(brows, mrows, args.threshold)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+        print(f"bench_trend: wrote {args.out}")
+    else:
+        print(md, end="")
+    return 1 if any(r["status"] == "REGRESSED" for r in brows) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

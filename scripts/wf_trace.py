@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""wf_trace — flight-recorder diagnosis CLI.
+
+Converts a tracing run's artifacts into a Chrome trace-event file (Perfetto /
+chrome://tracing loadable — drop it next to an ``xprof_trace`` capture) and,
+with ``--report``, prints the critical-path breakdown: per-stage service vs
+SPSC queue wait vs governor throttle vs supervised restart/shed attribution,
+plus a drill-down of the slowest traced batches and the p99 exemplar from the
+metrics snapshot.
+
+Inputs (produced by a run with ``trace=``/``WF_TRACE`` on; the journal and
+snapshot pieces appear when ``monitoring=``/``WF_MONITORING`` ran too):
+
+- ``<trace-dir>/flight.jsonl`` + ``meta.json``  — the flight recorder dump
+- ``<monitoring-dir>/events.jsonl``             — the event journal
+- ``<monitoring-dir>/snapshot.json``            — latency histograms/exemplars
+
+Stdlib only (``observability/tracing.py`` and ``journal.py`` are loaded by
+file path, bypassing the package ``__init__`` and its JAX imports), so this
+works on any box the artifacts were copied to:
+
+    python scripts/wf_trace.py --trace-dir wf_trace
+    python scripts/wf_trace.py --trace-dir wf_trace \\
+        --monitoring-dir wf_monitoring --report
+
+Exit codes: 0 = trace written, 2 = missing/unreadable inputs or usage error.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tracing():
+    """Load observability/tracing.py (and the journal module its relative
+    import names) by file path under a synthetic package — no windflow_tpu
+    package import, no JAX."""
+    obs = os.path.join(REPO, "windflow_tpu", "observability")
+    pkg = types.ModuleType("wf_obs")
+    pkg.__path__ = [obs]
+    sys.modules["wf_obs"] = pkg
+    for name in ("journal", "tracing"):
+        spec = importlib.util.spec_from_file_location(
+            f"wf_obs.{name}", os.path.join(obs, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"wf_obs.{name}"] = mod
+        spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return sys.modules["wf_obs.tracing"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_trace",
+        description="windflow_tpu flight-recorder diagnosis CLI")
+    ap.add_argument("--trace-dir", default="wf_trace",
+                    help="Tracer output directory (flight.jsonl + meta.json)")
+    ap.add_argument("--monitoring-dir", default=None,
+                    help="monitoring output directory (events.jsonl + "
+                         "snapshot.json) for journal correlation; default: "
+                         "./wf_monitoring when it exists")
+    ap.add_argument("--out", default=None,
+                    help="Chrome trace-event output path "
+                         "(default: <trace-dir>/trace.json)")
+    ap.add_argument("--report", action="store_true",
+                    help="print the critical-path breakdown and slowest-"
+                         "batch drill-down")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest batches to drill into (default 5)")
+    args = ap.parse_args(argv)
+
+    tracing = _load_tracing()
+    try:
+        records, meta = tracing.load_flight(args.trace_dir)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"wf_trace: cannot load flight recorder from "
+              f"{args.trace_dir!r}: {type(e).__name__}: {e}\n"
+              f"(run with trace=/the WF_TRACE env flag set to produce "
+              f"flight.jsonl + meta.json)", file=sys.stderr)
+        return 2
+
+    mon_dir = args.monitoring_dir
+    if mon_dir is None and os.path.isdir("wf_monitoring"):
+        mon_dir = "wf_monitoring"
+    journal_events, snapshot = [], None
+    if mon_dir:
+        ev_path = os.path.join(mon_dir, "events.jsonl")
+        if os.path.exists(ev_path):
+            # the journal module was loaded alongside tracing — ONE parser
+            journal_events = sys.modules["wf_obs.journal"].read_journal(
+                ev_path)
+        snap_path = os.path.join(mon_dir, "snapshot.json")
+        if os.path.exists(snap_path):
+            with open(snap_path) as f:
+                snapshot = json.load(f)
+
+    out_path = args.out or os.path.join(args.trace_dir, "trace.json")
+    trace = tracing.to_chrome_trace(records, journal_events, meta)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    n_spans = sum(1 for e in trace["traceEvents"] if e["ph"] == "B")
+    print(f"wf_trace: wrote {out_path} — {len(trace['traceEvents'])} events "
+          f"({n_spans} spans) from {len(records)} flight records, "
+          f"{len(journal_events)} journal events "
+          f"(load in Perfetto / chrome://tracing)")
+    if trace["otherData"].get("dropped_begins"):
+        print(f"wf_trace: note: {trace['otherData']['dropped_begins']} "
+              f"unmatched begin record(s) dropped (ring wrap or a crash "
+              f"without supervision)")
+    if args.report:
+        print()
+        print(tracing.critical_path_report(
+            records, journal_events, snapshot, meta, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

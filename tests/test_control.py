@@ -137,7 +137,7 @@ def test_drop_oldest_ts_sheds_stale_holds_fresh():
     assert adm.offer(b2) == []               # held (2 = hold_max)
     assert adm.offer(b3) == []               # overflow: b1 (oldest ts) shed
     assert adm.shed == 1
-    held_ids = [int(np.asarray(b.id)[0]) for b, _ in adm.held]
+    held_ids = [int(np.asarray(b.id)[0]) for b, *_ in adm.held]
     assert held_ids == [200, 300]            # stale dropped, fresh kept
     drained = adm.drain()                    # EOS admits the bounded tail
     assert [int(np.asarray(b.id)[0]) for b in drained] == [200, 300]

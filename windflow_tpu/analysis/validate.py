@@ -38,6 +38,11 @@ WF106  warning   prefetch depth exceeds the first ring's capacity
 WF107  warning   dangling branch: a pipe with no sink, no in-graph
                  ReduceSink, and no downstream edge — its output is
                  silently discarded
+WF108  error     trace config illegal / non-deterministic under the
+                 chosen driver (unparseable WF_TRACE/WF_TRACE_SAMPLE;
+                 ``ids="sequence"`` under supervision — a replay after
+                 restore would mint fresh ids and orphan every
+                 exemplar and ring-edge flow)
 ====== ========= =====================================================
 
 Usage::
@@ -339,6 +344,35 @@ def _check_admission(report, cfg, supervised: bool, where: str) -> None:
             hint="supervised drivers support shed_policy='drop_newest' only")
 
 
+def _check_trace(report, trace, stored_arg, supervised: bool) -> None:
+    """WF108: the tracing mirror of :func:`_check_admission` — resolve the
+    trace config exactly as the driver will (explicit ``trace=`` wins, else
+    the object's stored ``trace=`` argument / ``WF_TRACE``) and reject
+    configurations the supervised drivers would refuse mid-run."""
+    from ..observability.tracing import TraceConfig
+    try:
+        cfg = TraceConfig.resolve(trace if trace is not None else stored_arg)
+    except (ValueError, TypeError) as e:
+        report.add("WF108", "error", "trace",
+                   f"trace config does not resolve: {type(e).__name__}: {e}",
+                   hint="trace= accepts None/bool/out-dir string/TraceConfig;"
+                        " WF_TRACE_SAMPLE must be a positive integer")
+        return
+    if cfg is None:
+        return
+    if supervised and cfg.ids != "position":
+        report.add(
+            "WF108", "error", "trace",
+            f"trace ids={cfg.ids!r} under supervision: sequence ids come "
+            f"from a process-global counter, so a replay after a restore "
+            f"mints fresh ids — every exemplar and ring-edge flow recorded "
+            f"before the failure dangles",
+            hint="use TraceConfig(ids='position') (the default) — ids become "
+                 "a pure function of (run_id, stream, position), the same "
+                 "replay-determinism contract as the admission "
+                 "PositionBucket")
+
+
 def _check_prefetch(report, prefetch: int, first_edge) -> None:
     if not prefetch or first_edge is None:
         return
@@ -392,7 +426,8 @@ def _validate_chain_ops(report, ops, in_spec, in_cap, where: str,
     return out
 
 
-def _validate_pipeline(report, p, faults, control, supervised) -> None:
+def _validate_pipeline(report, p, faults, control, supervised,
+                       trace=None) -> None:
     cfg = _resolve_control(control, getattr(p, "_control", None))
     in_spec = _source_spec(report, p.source, f"source:{p.source.getName()}")
     if in_spec is None:
@@ -404,9 +439,10 @@ def _validate_pipeline(report, p, faults, control, supervised) -> None:
                         sink=p.sink)
     _check_faults(report, faults, "supervised" if supervised else "pipeline")
     _check_admission(report, cfg, supervised, "control.admission")
+    _check_trace(report, trace, getattr(p, "_trace_arg", None), supervised)
 
 
-def _validate_supervised(report, sp, faults, control) -> None:
+def _validate_supervised(report, sp, faults, control, trace=None) -> None:
     cfg = _resolve_control(control, getattr(sp, "_control", None))
     in_spec = _source_spec(report, sp.source,
                            f"source:{sp.source.getName()}")
@@ -417,9 +453,11 @@ def _validate_supervised(report, sp, faults, control) -> None:
     _check_faults(report, faults if faults is not None
                   else getattr(sp, "_faults_arg", None), "supervised")
     _check_admission(report, cfg, True, "control.admission")
+    _check_trace(report, trace, getattr(sp, "_trace_arg", None), True)
 
 
-def _validate_threaded(report, tp, faults, control, supervised) -> None:
+def _validate_threaded(report, tp, faults, control, supervised,
+                       trace=None) -> None:
     cfg = _resolve_control(control, getattr(tp, "_control", None))
     spec = _source_spec(report, tp.source,
                         f"source:{tp.source.getName()}")
@@ -443,6 +481,7 @@ def _validate_threaded(report, tp, faults, control, supervised) -> None:
     _check_faults(report, faults if faults is not None
                   else getattr(tp, "_faults_arg", None), "threaded")
     _check_admission(report, cfg, supervised, "control.admission")
+    _check_trace(report, trace, getattr(tp, "_trace_arg", None), supervised)
 
 
 def _graph_edges(g):
@@ -473,7 +512,7 @@ def _check_graph_edges(report, g, cfg) -> None:
 
 
 def _validate_graph(report, g, faults, control, supervised,
-                    threaded) -> None:
+                    threaded, trace=None) -> None:
     from ..basic import DEFAULT_BATCH_SIZE
     from ..control import ControlConfig
     from ..runtime.pipeline import resolve_batch_hint
@@ -536,23 +575,26 @@ def _validate_graph(report, g, faults, control, supervised,
               else ("graph-threaded" if threaded else "graph"))
     _check_faults(report, faults, driver)
     _check_admission(report, cfg, supervised, "control.admission")
+    _check_trace(report, trace, getattr(g, "_trace_arg", None), supervised)
 
 
 def _validate_compiled_chain(report, chain, faults, control,
-                             supervised) -> None:
+                             supervised, trace=None) -> None:
     _flow_ops(report, chain.ops, chain.specs[0], "chain", None)
     _check_faults(report, faults, "supervised" if supervised else "pipeline")
     from ..control import ControlConfig
     _check_admission(report, ControlConfig.resolve(control)
                      if control is not None else None,
                      supervised, "control.admission")
+    if trace is not None:
+        _check_trace(report, trace, None, supervised)
 
 
 # ------------------------------------------------------------------ public
 
 
 def validate(obj, *, faults=None, control=None, supervised: bool = None,
-             threaded: bool = False) -> ValidationReport:
+             threaded: bool = False, trace=None) -> ValidationReport:
     """Validate a built-but-not-run driver object; returns a
     :class:`ValidationReport` (never raises on findings — call
     ``.raise_if_errors()`` to gate).
@@ -570,7 +612,11 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     ``supervised``: declare that the object will run under supervision
     (``run_supervised`` / ``run_graph_supervised``); inferred True for a
     ``SupervisedPipeline``. ``threaded``: a ``PipeGraph`` destined for
-    ``run(threaded=True)`` (enables the ring-edge checks)."""
+    ``run(threaded=True)`` (enables the ring-edge checks).
+
+    ``trace``: a ``TraceConfig``/bool/out-dir overriding the object's own
+    stored ``trace=`` argument for the WF108 determinism checks; ``None``
+    consults the stored argument and ``WF_TRACE`` (mirroring the drivers)."""
     from ..runtime.pipegraph import PipeGraph
     from ..runtime.pipeline import CompiledChain, Pipeline
     from ..runtime.supervisor import SupervisedPipeline
@@ -579,20 +625,22 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     if isinstance(obj, PipeGraph):
         report = ValidationReport(f"PipeGraph({obj.name!r})")
         _validate_graph(report, obj, faults, control, bool(supervised),
-                        threaded)
+                        threaded, trace)
     elif isinstance(obj, SupervisedPipeline):
         report = ValidationReport("SupervisedPipeline")
-        _validate_supervised(report, obj, faults, control)
+        _validate_supervised(report, obj, faults, control, trace)
     elif isinstance(obj, ThreadedPipeline):
         report = ValidationReport("ThreadedPipeline")
-        _validate_threaded(report, obj, faults, control, bool(supervised))
+        _validate_threaded(report, obj, faults, control, bool(supervised),
+                           trace)
     elif isinstance(obj, Pipeline):
         report = ValidationReport("Pipeline")
-        _validate_pipeline(report, obj, faults, control, bool(supervised))
+        _validate_pipeline(report, obj, faults, control, bool(supervised),
+                           trace)
     elif isinstance(obj, CompiledChain):
         report = ValidationReport("CompiledChain")
         _validate_compiled_chain(report, obj, faults, control,
-                                 bool(supervised))
+                                 bool(supervised), trace)
     else:
         report = ValidationReport(type(obj).__name__)
         report.add("WF100", "error", "target",

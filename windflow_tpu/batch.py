@@ -32,6 +32,27 @@ import numpy as np
 #: ids and relative-usecs timestamps fit comfortably for streaming benchmarks.
 CTRL_DTYPE = jnp.int32
 
+#: Host-side sidecar metadata convention (the causal-tracing trace id,
+#: ``observability/tracing.py``): metadata rides on the *Python* Batch object
+#: under this attribute — set via ``object.__setattr__`` on the frozen
+#: dataclass, NEVER as a pytree field, so compiled programs, cached
+#: executables, and checkpoints are byte-identical with tracing on or off.
+#: The sidecar does not survive jit/``jax.tree.map``/``dataclasses.replace``
+#: (those build new objects); driver loops re-attach it across operator hops
+#: with ``observability.tracing.carry`` (tracing.py mirrors this attribute
+#: name as a literal — it must stay importable without JAX, so it cannot
+#: import this module).  Rebatching (``split_batch``/``concat_batches``)
+#: intentionally drops it: a merged or split batch is no longer the ingested
+#: unit the id names.
+TRACE_META_ATTR = "_wf_trace"
+
+
+def trace_meta(batch):
+    """The batch's host-side trace metadata (trace id), or None — the
+    user-facing reader (e.g. inside a Sink callback over a host batch);
+    runtime attach/propagate lives in ``observability.tracing``."""
+    return getattr(batch, TRACE_META_ATTR, None)
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)

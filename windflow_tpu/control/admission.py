@@ -142,13 +142,14 @@ class AdmissionController:
     def _cost(self, batch) -> int:
         return int(batch.capacity)
 
-    def _shed(self, batch, pos) -> None:
+    def _shed(self, batch, pos, stream=None) -> None:
         cost = self._cost(batch)
         self.shed += 1
         _state.bump("shed_batches")
         _state.bump("shed_tuples", cost)
+        extra = {} if stream is None else {"stream": stream}
         _journal.record("shed", policy=self.policy, driver=self.driver,
-                        pos=pos, tuples=cost)
+                        pos=pos, tuples=cost, **extra)
 
     def _admit(self, batch) -> None:
         self.admitted += 1
@@ -157,27 +158,32 @@ class AdmissionController:
 
     # -- surface ------------------------------------------------------------
 
-    def offer(self, batch, pos=None) -> List:
-        """Offer one source batch; returns the batches admitted right now."""
+    def offer(self, batch, pos=None, stream=None) -> List:
+        """Offer one source batch; returns the batches admitted right now.
+        ``pos``/``stream`` are journal coordinates only (never part of the
+        shed decision): the graph drivers pass the per-root offered position
+        and the root index — the SAME coordinates causal tracing mints ids
+        from, so ``wf_trace.py --report`` joins shed events to traced
+        batches exactly."""
         with self._lock:
             self.bucket.tick()
             if self.policy == "drop_newest":
                 if self.bucket.try_take(self._cost(batch)):
                     self._admit(batch)
                     return [batch]
-                self._shed(batch, pos)
+                self._shed(batch, pos, stream)
                 return []
             # drop_oldest_ts: FIFO holding cell, shed from the stale end
-            self.held.append((batch, pos))
+            self.held.append((batch, pos, stream))
             out = []
             while self.held and self.bucket.try_take(
                     self._cost(self.held[0][0])):
-                b, _ = self.held.popleft()
+                b, _, _ = self.held.popleft()
                 self._admit(b)
                 out.append(b)
             while len(self.held) > self.hold_max:
-                b, p = self.held.popleft()    # oldest ts first
-                self._shed(b, p)
+                b, p, s = self.held.popleft()    # oldest ts first
+                self._shed(b, p, s)
             return out
 
     def drain(self) -> List:
@@ -185,7 +191,7 @@ class AdmissionController:
         with self._lock:
             out = []
             while self.held:
-                b, _ = self.held.popleft()
+                b, _, _ = self.held.popleft()
                 self._admit(b)
                 out.append(b)
             return out

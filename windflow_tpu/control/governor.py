@@ -23,6 +23,7 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 from ..observability import journal as _journal
+from ..observability import tracing as _tracing
 from . import _state
 
 
@@ -81,6 +82,10 @@ class BackpressureGovernor:
         self.throttles += 1
         _state.bump("throttle_events")
         _journal.record("throttle", edge=edge, depth=depth, high=hi)
+        # throttle episodes also land in the flight recorder (a span on the
+        # "governor" pseudo-stage) so the Perfetto view shows exactly which
+        # batches sat behind a throttled source — one None check when off
+        stall = _tracing.stall(f"governor:{edge}")
         self.pause_event.set()
         t0 = self.clock()
         try:
@@ -90,6 +95,8 @@ class BackpressureGovernor:
                 time.sleep(self.poll_s)
         finally:
             self.pause_event.clear()
+            if stall is not None:
+                stall.done()
         dt = self.clock() - t0
         _state.bump("throttle_seconds", dt)
         _journal.record("throttle_end", edge=edge, waited_s=round(dt, 6))

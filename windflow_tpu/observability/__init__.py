@@ -34,16 +34,18 @@ from typing import Optional, Union
 from .journal import EventJournal, read_journal, set_active as set_journal
 from .metrics import LogHistogram, MetricsRegistry
 from .names import (CONTROL_COUNTERS, CONTROL_GAUGES, JOURNAL_EVENTS,
-                    RECOVERY_COUNTERS)
+                    RECOVERY_COUNTERS, TRACE_RECORD_KINDS, TRACE_STAGES)
 from .reporter import Reporter
 from .topology import (graph_topology_dot, graph_topology_json,
                        pipeline_topology_dot, pipeline_topology_json,
                        topology_dot, topology_json)
-from . import journal
+from .tracing import TraceConfig, Tracer
+from . import journal, tracing
 
 __all__ = [
     "LogHistogram", "MetricsRegistry", "Reporter", "EventJournal",
     "MonitoringConfig", "Monitor", "journal", "read_journal", "set_journal",
+    "TraceConfig", "Tracer", "tracing",
     "topology_dot", "topology_json", "graph_topology_dot",
     "graph_topology_json", "pipeline_topology_dot", "pipeline_topology_json",
 ]
@@ -57,6 +59,11 @@ class MonitoringConfig:
     interval_s: float = 1.0
     prometheus: bool = True
     journal: bool = True
+    #: None = flush the event journal per event (crash-safe, the supervised
+    #: default); an int N = batched mode, flushed every N events (and always
+    #: on errors/close) — for tracing-heavy runs where a syscall per sampled
+    #: span would dominate (see EventJournal)
+    journal_flush_interval: "Optional[int]" = None
     #: sample every Nth source batch for the end-to-end latency histogram
     #: (a sample is two perf_counter reads around a sink receipt that is
     #: host-synchronous anyway — cheap, so the default is dense)
@@ -111,7 +118,8 @@ class Monitor:
         self.journal: Optional[EventJournal] = None
         if config.journal:
             self.journal = EventJournal(
-                os.path.join(config.out_dir, "events.jsonl"))
+                os.path.join(config.out_dir, "events.jsonl"),
+                flush_interval=config.journal_flush_interval)
         self.reporter = Reporter(self.registry, config.out_dir,
                                  interval_s=config.interval_s,
                                  prometheus=config.prometheus)

@@ -27,17 +27,30 @@ from typing import Optional
 
 
 class EventJournal:
-    """Append-only JSONL journal. Thread-safe; flushes per event (events are
-    checkpoint/EOS-granular, not per-tuple)."""
+    """Append-only JSONL journal. Thread-safe.
 
-    def __init__(self, path: str):
+    Flushing: the default (``flush_interval=None``) flushes per event —
+    events are checkpoint/EOS-granular, not per-tuple, and a crash must not
+    lose the records describing it, so supervised runs keep this mode.
+    Tracing-heavy runs (sampled launches, per-batch spans) can opt into
+    batched flushing with ``flush_interval=N``: the stream is flushed every N
+    events instead of paying a write syscall per record; error-carrying
+    records and ``close()`` always flush immediately, so the failure tail is
+    never buffered away."""
+
+    def __init__(self, path: str, flush_interval: Optional[int] = None):
         self.path = path
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        self._f = open(path, "a", buffering=1)
+        self.flush_interval = (None if not flush_interval
+                               else max(1, int(flush_interval)))
+        # line buffering when per-event; block buffering when batched
+        self._f = open(path, "a",
+                       buffering=(1 if self.flush_interval is None else -1))
         self._lock = threading.Lock()
         self._span_seq = 0
+        self._since_flush = 0
         self.events_written = 0
 
     def event(self, name: str, **fields) -> None:
@@ -49,6 +62,12 @@ class EventJournal:
                 return
             self._f.write(line + "\n")
             self.events_written += 1
+            if self.flush_interval is not None:
+                self._since_flush += 1
+                if (self._since_flush >= self.flush_interval
+                        or "error" in rec):
+                    self._f.flush()
+                    self._since_flush = 0
 
     @contextlib.contextmanager
     def span(self, name: str, **fields):

@@ -41,9 +41,15 @@ class LogHistogram:
         self.sum = 0.0
         self.min = float("inf")
         self.max = 0.0
+        #: latency exemplars: bucket index -> the LAST trace id that landed
+        #: there (observability/tracing.py) — links a percentile line in the
+        #: snapshot to a concrete traced batch.  Populated only when callers
+        #: pass ``exemplar=`` (tracing on), so the plain path pays one None
+        #: check.
+        self.exemplars: Dict[int, int] = {}
         self._lock = threading.Lock()
 
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float, exemplar=None) -> None:
         s = float(seconds)
         if s < 0.0:
             s = 0.0
@@ -56,30 +62,48 @@ class LogHistogram:
                 self.min = s
             if s > self.max:
                 self.max = s
+            if exemplar is not None:
+                self.exemplars[i] = exemplar
 
-    def percentile(self, q: float) -> float:
-        """Approximate q-th percentile (q in [0, 100]): the upper bound of the
-        bucket holding the q-th sample — an overestimate by at most one bucket
-        width (factor sqrt(2))."""
+    def _percentile_bucket(self, q: float) -> Optional[int]:
+        """Index of the bucket holding the q-th sample; None when empty."""
         if not self.count:
-            return 0.0
+            return None
         target = max(1, int(q / 100.0 * self.count + 0.5))
         acc = 0
         for i, c in enumerate(self.counts):
             acc += c
             if acc >= target:
-                if i >= _N_BUCKETS:              # overflow bucket
-                    return self.max
-                return min(self.BOUNDS[i], self.max)
-        return self.max
+                return i
+        return len(self.counts) - 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100]): the upper bound of the
+        bucket holding the q-th sample — an overestimate by at most one bucket
+        width (factor sqrt(2))."""
+        i = self._percentile_bucket(q)
+        if i is None:
+            return 0.0
+        if i >= _N_BUCKETS:                      # overflow bucket
+            return self.max
+        return min(self.BOUNDS[i], self.max)
+
+    def exemplar(self, q: float) -> Optional[int]:
+        """Trace id of the last sample that landed in the q-th percentile's
+        bucket (None when empty or never traced) — THE link from a histogram
+        line to a concrete batch in the flight recorder."""
+        i = self._percentile_bucket(q)
+        return None if i is None else self.exemplars.get(i)
 
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
     def summary_us(self) -> Dict[str, float]:
-        """p50/p95/p99 + mean in microseconds (the snapshot's unit)."""
-        return {
+        """p50/p95/p99 + mean in microseconds (the snapshot's unit).  When
+        tracing supplied exemplars, ``p99_exemplar`` names the trace id of
+        the last batch that landed in the p99 bucket."""
+        out = {
             "p50": round(self.percentile(50) * 1e6, 3),
             "p95": round(self.percentile(95) * 1e6, 3),
             "p99": round(self.percentile(99) * 1e6, 3),
@@ -87,6 +111,10 @@ class LogHistogram:
             "max": round(self.max * 1e6, 3) if self.count else 0.0,
             "samples": self.count,
         }
+        ex = self.exemplar(99)
+        if ex is not None:
+            out["p99_exemplar"] = ex
+        return out
 
     def prometheus_buckets(self):
         """Cumulative (le_seconds, count) pairs, Prometheus histogram form."""
@@ -180,8 +208,8 @@ class MetricsRegistry:
         if capacity is not None:
             self._queue_capacities[edge] = int(capacity)
 
-    def record_e2e(self, seconds: float) -> None:
-        self.e2e_hist.record(seconds)
+    def record_e2e(self, seconds: float, exemplar=None) -> None:
+        self.e2e_hist.record(seconds, exemplar=exemplar)
 
     # -- collection -------------------------------------------------------------------
 
@@ -281,6 +309,7 @@ class MetricsRegistry:
                         merged.sum += h.sum
                         merged.max = max(merged.max, h.max)
                         merged.min = min(merged.min, h.min)
+                        merged.exemplars.update(h.exemplars)
                 row["service_time_us"] = merged.summary_us()
                 # rates vs the previous snapshot. Mid-chain operators count
                 # batches/bytes, not tuples (per-tuple counts would need a

@@ -43,7 +43,25 @@ JOURNAL_EVENTS = (
     # control/autotune.py, runtime/supervisor.py warm start)
     "shed", "throttle", "throttle_end",
     "capacity_switch", "tuning_converged", "tuning_warm_start",
+    # per-batch causal tracing lifecycle (observability/tracing.py Tracer)
+    "trace_start", "trace_end",
 )
+
+#: flight-recorder record kinds (``observability/tracing.py``; the
+#: ``flight.jsonl`` schema consumed by ``scripts/wf_trace.py``) — listed here
+#: so tooling has one source of truth beside the journal/counter names
+TRACE_RECORD_KINDS = ("ingest", "enq", "deq", "begin", "end")
+
+#: flight-recorder stage labels minted OUTSIDE driver loops (driver stages
+#: and ring edges are named by the drivers themselves: "chain", "seg<i>",
+#: "pipe<i>", "sink", and the edge labels of ``PipeGraph._iter_edges`` /
+#: ``ThreadedPipeline.edge_names``)
+TRACE_STAGES = ("ingest",)
+
+#: stage-label *families* (prefix + variable suffix): governor throttle
+#: episodes record on ``governor:<edge>`` pseudo-stages
+#: (``control/governor.py``) — match by prefix, not equality
+TRACE_STAGE_PREFIXES = ("governor:",)
 
 #: process-wide recovery counters (``runtime/faults.py``; surfaced in the
 #: metrics snapshot under ``"recovery"`` and in Prometheus as

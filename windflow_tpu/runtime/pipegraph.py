@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from ..basic import Mode, DEFAULT_BATCH_SIZE
 from ..batch import Batch, concat_batches, tuple_refs
+from ..observability import tracing as _tracing
 from ..operators.base import Basic_Operator
 from ..operators.sink import ReduceSink, Sink
 from ..operators.source import SourceBase
@@ -254,7 +255,7 @@ class PipeGraph:
 
     def __init__(self, name: str = "pipegraph", mode: Mode = Mode.DEFAULT,
                  batch_size: int = None, monitoring=None, control=None,
-                 queue_capacity=8):
+                 queue_capacity=8, trace=None):
         self.name = name
         self.mode = mode
         #: None = resolve at start(): min withBatch hint over registered
@@ -268,6 +269,13 @@ class PipeGraph:
         #: cost beyond a None check).
         self._monitoring_arg = monitoring
         self._monitor = None
+        #: per-batch causal tracing opt-in (mirrors monitoring=): None =
+        #: consult WF_TRACE; resolved at start(). Trace ids are minted per
+        #: (root stream, offered position) — deterministic, so the supervised
+        #: driver replays identical ids after a restore.
+        self._trace_arg = trace
+        self._tracer = None
+        self._trace_labels = None     # id(pipe) -> "pipe<i>" (lazy)
         #: control-plane opt-in (mirrors monitoring=/faults=): None = consult
         #: WF_CONTROL; resolved at start(). Admission control gates every
         #: source loop; the backpressure governor throttles the threaded
@@ -326,6 +334,18 @@ class PipeGraph:
         if self._control is None:
             from ..control import ControlConfig
             self._control = ControlConfig.resolve(self._control_arg)
+        if self._tracer is None:
+            from ..observability import TraceConfig, Tracer
+            tcfg = TraceConfig.resolve(self._trace_arg)
+            if tcfg is not None:
+                self._tracer = Tracer(tcfg, self.name).start()
+
+    def _trace_label(self, mp) -> str:
+        """Flight-recorder stage label of one pipe (stable pipe index)."""
+        if self._trace_labels is None or id(mp) not in self._trace_labels:
+            self._trace_labels = {id(p): f"pipe{i}"
+                                  for i, p in enumerate(self._all_pipes())}
+        return self._trace_labels.get(id(mp), "pipe?")
 
     def _make_admissions(self, driver: str):
         """Per-source admission controllers over ONE shared token bucket
@@ -385,6 +405,7 @@ class PipeGraph:
         in_queues = {id(p): [] for p in pipes}
         out_edges = {}                           # (producer id, consumer id) -> queue
         channel_of = {}                          # queue id -> merge channel index
+        edge_label = {}                          # queue id -> edge label (tracing)
         from .threaded import _resolve_edge_capacity
         from ..control import governor_from_config
         governor = governor_from_config(self._control)
@@ -395,6 +416,7 @@ class PipeGraph:
             q = SPSCQueue(cap)
             in_queues[id(dst)].append(q)
             out_edges[("src" if prod is None else id(prod), id(dst))] = q
+            edge_label[id(q)] = label
             if self._monitor is not None:
                 # live ring-depth gauge per dataflow edge: depth near capacity
                 # = backpressure, the consumer pipe is the bottleneck
@@ -416,9 +438,15 @@ class PipeGraph:
                         keep = sel[:, i].astype(jnp.bool_)
                     else:
                         keep = jnp.asarray(sel, jnp.int32) == i
-                    out_edges[(id(mp), id(branch))].push(out.mask(keep))
+                    q = out_edges[(id(mp), id(branch))]
+                    masked = out.mask(keep)
+                    _tracing.carry(out, masked)
+                    _tracing.event(masked, edge_label[id(q)], "enq")
+                    q.push(masked)
             for merged in mp._outputs_to:
-                out_edges[(id(mp), id(merged))].push(out)
+                q = out_edges[(id(mp), id(merged))]
+                _tracing.event(out, edge_label[id(q)], "enq")
+                q.push(out)
 
         def propagate_eos(mp):
             from ..observability import journal as _journal
@@ -438,7 +466,12 @@ class PipeGraph:
 
             def run_batch(item):
                 chain = mp._compile(item.capacity)
-                deliver(mp, chain.push(item))
+                span = _tracing.service(item, self._trace_label(mp))
+                out = chain.push(item)
+                if span is not None:
+                    span.done()
+                    _tracing.carry(item, out)
+                deliver(mp, out)
 
             live = list(in_queues[id(mp)])
             try:
@@ -456,11 +489,13 @@ class PipeGraph:
                                     run_batch(piece)
                             continue
                         if onode is not None and id(q) in channel_of:
+                            _tracing.event(item, edge_label[id(q)], "deq")
                             rel = onode.push(channel_of[id(q)], item)
                             for piece in self._chunks(
                                     rel, onode.last_release_count):
                                 run_batch(piece)
                         else:
+                            _tracing.event(item, edge_label[id(q)], "deq")
                             run_batch(item)
                 if onode is not None:
                     for piece in self._chunks(onode.flush(),
@@ -490,15 +525,18 @@ class PipeGraph:
             from .pipeline import record_source_launch
             q = out_edges[("src", id(mp))]
             adm = admissions.get(id(mp))
+            stream = self._roots.index(mp)
             try:
                 n = 0
                 for batch in mp.source.batches(self.batch_size):
                     record_source_launch(mp.source, batch)
-                    admitted = (batch,) if adm is None else adm.offer(batch,
-                                                                      pos=n)
+                    _tracing.ingest(batch, n, stream=stream)
+                    admitted = (batch,) if adm is None else adm.offer(
+                        batch, pos=n, stream=stream)
                     for ab in admitted:
                         if governor is not None:
                             governor.throttle()
+                        _tracing.event(ab, edge_label[id(q)], "enq")
                         q.push(ab)
                     n += 1
                 if adm is not None:
@@ -535,6 +573,8 @@ class PipeGraph:
         finally:
             if governor is not None:
                 governor.stop()
+            if self._tracer is not None:
+                self._tracer.finish()
             if self._monitor is not None:
                 self._monitor.finish(self)
 
@@ -555,6 +595,10 @@ class PipeGraph:
             live = list(sources)
             round_robin_pos = 0
             n_pushed = 0
+            # trace ids are minted per (root stream, per-root offered
+            # position) — the same coordinates the supervised driver replays
+            root_idx = {id(mp): i for i, mp in enumerate(self._roots)}
+            offered = {id(mp): 0 for mp in self._roots}
             while live:
                 mp, it = live[round_robin_pos % len(live)]
                 try:
@@ -568,9 +612,15 @@ class PipeGraph:
                     self._exhaust(mp)
                     continue
                 record_source_launch(mp.source, batch)
+                opos = offered[id(mp)]
+                _tracing.ingest(batch, opos, stream=root_idx[id(mp)])
+                offered[id(mp)] += 1
                 adm = admissions.get(id(mp))
-                admitted = (batch,) if adm is None else adm.offer(batch,
-                                                                  pos=n_pushed)
+                # shed journal coordinates = (stream, per-root offered pos),
+                # the same coordinates trace ids are minted from — wf_trace's
+                # report joins shed events to traced batches on them
+                admitted = (batch,) if adm is None else adm.offer(
+                    batch, pos=opos, stream=root_idx[id(mp)])
                 round_robin_pos += 1
                 for ab in admitted:
                     if (self._monitor is not None
@@ -603,6 +653,8 @@ class PipeGraph:
             self._ended = True
             return self._results()
         finally:
+            if self._tracer is not None:
+                self._tracer.finish()
             if self._monitor is not None:
                 self._monitor.finish(self)
 
@@ -698,7 +750,13 @@ class PipeGraph:
     def _push(self, mp: MultiPipe, batch: Batch):
         """Push one batch through mp's chain and onward through split/merge edges."""
         chain = mp._compile(batch.capacity)
+        tr = _tracing.get_active()
+        span = tr.service(batch, self._trace_label(mp)) if tr is not None \
+            else None
         out = chain.push(batch)
+        if span is not None:
+            span.done()
+            _tracing.carry(batch, out)
         self._deliver(mp, out)
 
     def _ordering_of(self, merged: MultiPipe):
@@ -752,7 +810,8 @@ class PipeGraph:
             if self._e2e_t0 is not None and self._monitor is not None:
                 import time as _time
                 self._monitor.registry.record_e2e(
-                    _time.perf_counter() - self._e2e_t0)
+                    _time.perf_counter() - self._e2e_t0,
+                    exemplar=_tracing.tid_of(out))
                 self._e2e_t0 = None    # one sample per sampled source batch
         if mp.split_fn is not None:
             self._push_split(mp, out)
@@ -774,7 +833,10 @@ class PipeGraph:
                 keep = sel[:, i].astype(jnp.bool_)
             else:
                 keep = jnp.asarray(sel, jnp.int32) == i
-            self._push(branch, out.mask(keep))
+            masked = out.mask(keep)
+            _tracing.carry(out, masked)     # mask() builds a new Batch — the
+            #                                 trace sidecar must follow it
+            self._push(branch, masked)
 
     def _check_merge_legality(self, pipes):
         """The reference's merge rules (``wf/pipegraph.hpp:813-965,2992-3026``).

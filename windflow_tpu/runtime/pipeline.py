@@ -26,6 +26,7 @@ import jax
 
 from ..basic import DEFAULT_BATCH_SIZE
 from ..batch import Batch
+from ..observability import tracing as _tracing
 from ..operators.base import Basic_Operator
 from ..operators.sink import ReduceSink, Sink
 from ..operators.source import SourceBase
@@ -203,8 +204,14 @@ class CompiledChain:
         if self.ops:
             # H2D bytes are counted ONCE, at the source that framed the batch
             # (Pipeline.run / pipegraph source loops) — counting the possible
-            # device_put above too would double-count the same transfer
-            self.ops[from_op].get_StatsRecords()[0].record_launch(service_s)
+            # device_put above too would double-count the same transfer.
+            # Sampled launches carry the batch's trace id (if any) as the
+            # service-histogram exemplar — the p99 service bucket then names
+            # a concrete batch in the flight recorder.
+            self.ops[from_op].get_StatsRecords()[0].record_launch(
+                service_s,
+                exemplar=(None if service_s is None
+                          else _tracing.tid_of(batch)))
         return out
 
     def flush(self) -> List[Batch]:
@@ -245,7 +252,7 @@ class Pipeline:
     def __init__(self, source: SourceBase, ops: Sequence[Basic_Operator],
                  sink: Optional[Sink] = None, *,
                  batch_size: Optional[int] = None, prefetch: int = 0,
-                 monitoring=None, control=None):
+                 monitoring=None, control=None, trace=None):
         self.source = source
         self.sink = sink
         if batch_size is None:
@@ -280,6 +287,10 @@ class Pipeline:
         #: env change between construction and run() is honored
         self._monitoring_arg = monitoring
         self._monitor = None
+        #: per-batch causal tracing (None = consult WF_TRACE; see
+        #: observability.tracing.TraceConfig.resolve) — same lazy resolution
+        self._trace_arg = trace
+        self._tracer = None
 
     def _make_controller(self):
         """Assemble the run-scoped control pieces from the resolved config:
@@ -325,13 +336,18 @@ class Pipeline:
 
     def run(self):
         import time as _time
-        from ..observability import Monitor, MonitoringConfig
+        from ..observability import Monitor, MonitoringConfig, TraceConfig, \
+            Tracer
         cfg = MonitoringConfig.resolve(self._monitoring_arg)
         if cfg is not None and self._monitor is None:
             self._monitor = Monitor(cfg, self.source.getName() + "-pipeline")
             self._monitor.registry.register_pipeline(self)
             self._monitor.start()
         mon = self._monitor
+        tcfg = TraceConfig.resolve(self._trace_arg)
+        if tcfg is not None and self._tracer is None:
+            self._tracer = Tracer(tcfg,
+                                  self.source.getName() + "-pipeline").start()
         tuner, rebatcher, admission = self._make_controller()
         if mon is not None and tuner is not None:
             mon.registry.attach_gauge("control_chosen_capacity",
@@ -354,24 +370,38 @@ class Pipeline:
                 sampled = (mon is not None and self.sink is not None
                            and mon.config.should_sample_e2e(n))
                 t0 = _time.perf_counter() if sampled else 0.0
+                span = _tracing.service(b, "chain")
                 out = self.chain.push(b)
+                if span is not None:
+                    span.done()
+                    _tracing.carry(b, out)
                 if self.sink is not None:
+                    sspan = _tracing.service(out, "sink")
                     self.sink.consume(out)
+                    if sspan is not None:
+                        sspan.done()
                 if sampled:
                     # Sink.consume materialized the batch on the host (or the
                     # sink is in-graph) — this is a true source-framing ->
                     # host-receipt sample through device compute + transfer
-                    mon.registry.record_e2e(_time.perf_counter() - t0)
+                    mon.registry.record_e2e(_time.perf_counter() - t0,
+                                            exemplar=_tracing.tid_of(b))
                 n += 1
                 if tuner is not None:
                     newcap = tuner.on_batch(b.capacity)
                     if newcap is not None:
                         rebatcher.set_target(newcap)
 
+            n_offered = 0
             for batch in batches:
                 record_source_launch(self.source, batch)
+                _tracing.ingest(batch, n_offered)
+                # shed journal coordinate = the offered position trace ids
+                # are minted from (n counts DRIVEN batches, which drifts past
+                # a shed — the report joins on offered positions)
                 admitted = (batch,) if admission is None \
-                    else admission.offer(batch, pos=n)
+                    else admission.offer(batch, pos=n_offered)
+                n_offered += 1
                 for ab in admitted:
                     for rb in (rebatcher.feed(ab) if rebatcher is not None
                                else (ab,)):
@@ -397,5 +427,7 @@ class Pipeline:
                 op.close()            # closing_func per replica (svc_end parity)
             return self.chain.result()
         finally:
+            if self._tracer is not None:
+                self._tracer.finish()
             if mon is not None:
                 mon.finish(self)

@@ -45,6 +45,7 @@ from typing import List, Optional, Sequence
 from ..basic import DEFAULT_BATCH_SIZE
 from ..native import SPSCQueue, pin_thread
 from ..observability import journal as _journal
+from ..observability import tracing as _tracing
 from ..operators.sink import Sink
 from ..operators.source import SourceBase
 from . import faults as _faults
@@ -82,9 +83,12 @@ class ThreadedPipeline:
                  batch_size: int = DEFAULT_BATCH_SIZE,
                  queue_capacity=8, pin: bool = True,
                  heartbeat_timeout: Optional[float] = None, faults=None,
-                 prefetch: int = 0, control=None):
+                 prefetch: int = 0, control=None, trace=None):
         self.source = source
         self.sink = sink
+        #: per-batch causal tracing opt-in (trace= kwarg or WF_TRACE env)
+        self._trace_arg = trace
+        self._tracer = None
         self.batch_size = batch_size
         self.pin = pin
         self.heartbeat_timeout = heartbeat_timeout
@@ -160,6 +164,7 @@ class ThreadedPipeline:
                 self._beats[stage] = time.monotonic()
                 _faults.fire("source.next", stage=stage, pos=n)
                 record_source_launch(self.source, batch)
+                _tracing.ingest(batch, n)
                 admitted = (batch,) if adm is None else adm.offer(batch, pos=n)
                 for ab in admitted:
                     if gov is not None:
@@ -168,6 +173,7 @@ class ThreadedPipeline:
                         gov.throttle(heartbeat=lambda: self._beats.__setitem__(
                             stage, time.monotonic()))
                         self._beats[stage] = time.monotonic()
+                    _tracing.event(ab, self.edge_names[0], "enq")
                     self.queues[0].push(ab)
                 n += 1
             if adm is not None:
@@ -187,6 +193,7 @@ class ThreadedPipeline:
         if self.pin:
             pin_thread(core)
         chain, q_in, q_out = self.chains[i], self.queues[i], self.queues[i + 1]
+        edge_in, edge_out = self.edge_names[i], self.edge_names[i + 1]
         stage = f"seg{i}"
         self._beats[stage] = time.monotonic()
         eos_seen = False
@@ -204,7 +211,14 @@ class ThreadedPipeline:
                     break
                 _faults.fire("queue.stall", stage=stage, pos=n)
                 _faults.fire("chain.step", stage=stage, pos=n)
-                q_out.push(chain.push(item))
+                _tracing.event(item, edge_in, "deq")
+                span = _tracing.service(item, stage)
+                out = chain.push(item)
+                if span is not None:
+                    span.done()
+                    _tracing.carry(item, out)
+                    _tracing.event(out, edge_out, "enq")
+                q_out.push(out)
                 n += 1
         except BaseException as e:          # noqa: BLE001
             self._errors.append(e)
@@ -235,8 +249,12 @@ class ThreadedPipeline:
                     eos_seen = True
                     break
                 _faults.fire("sink.consume", stage=stage, pos=n)
+                _tracing.event(item, self.edge_names[-1], "deq")
+                span = _tracing.service(item, stage)
                 if self.sink is not None:
                     self.sink.consume(item)
+                if span is not None:
+                    span.done()
                 n += 1
             if self.sink is not None:
                 self.sink.consume(None)
@@ -269,6 +287,11 @@ class ThreadedPipeline:
 
     def run(self):
         injector = _faults.resolve(self._faults_arg)
+        from ..observability import TraceConfig, Tracer
+        tcfg = TraceConfig.resolve(self._trace_arg)
+        if tcfg is not None and self._tracer is None:
+            self._tracer = Tracer(tcfg,
+                                  self.source.getName() + "-threaded").start()
         cfg = self._control
         if cfg is not None:
             from ..control import admission_from_config, governor_from_config
@@ -285,6 +308,8 @@ class ThreadedPipeline:
             try:
                 return self._run()
             finally:
+                if self._tracer is not None:
+                    self._tracer.finish()
                 if self.governor is not None:
                     # never leave a source wedged in a throttle wait past
                     # teardown (the object stays readable for post-run stats)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 
 from .observability.metrics import LogHistogram
@@ -54,18 +55,21 @@ class Stats_Record:
         self.batches_sent += 1
 
     def record_launch(self, service_time_s: float = None, hd_bytes: int = 0,
-                      dh_bytes: int = 0):
+                      dh_bytes: int = 0, exemplar=None):
         """One compiled-program launch. ``service_time_s`` is a MEASURED
         dispatch->completion sample (the chain samples every Nth push with a
         block_until_ready so the async pipeline stays overlapped); pass None on
-        unsampled launches — only real samples enter the average."""
+        unsampled launches — only real samples enter the average.
+        ``exemplar`` (a trace id, when causal tracing is on) tags the
+        histogram bucket the sample lands in, linking the service-time
+        percentiles to a concrete batch in the flight recorder."""
         self.num_kernels += 1
         self.bytes_copied_hd += int(hd_bytes)
         self.bytes_copied_dh += int(dh_bytes)
         if service_time_s is not None:
             self._service_time_sum += float(service_time_s)
             self._service_samples += 1
-            self.service_hist.record(service_time_s)
+            self.service_hist.record(service_time_s, exemplar=exemplar)
 
     @property
     def avg_service_time_us(self) -> float:
@@ -101,6 +105,13 @@ class Stats_Record:
         return path
 
 
+#: the one profiler session JAX supports: who (if anyone) holds it.  Guarded
+#: so a nested/concurrent ``xprof_trace`` fails with a clear message instead
+#: of the raw ``start_trace`` error surfacing out of user code.
+_xprof_lock = threading.Lock()
+_xprof_logdir = None
+
+
 @contextlib.contextmanager
 def xprof_trace(logdir: str):
     """JAX profiler capture around a pipeline run — the Xprof half of the
@@ -112,10 +123,44 @@ def xprof_trace(logdir: str):
 
     Works on CPU and TPU backends; on TPU the trace includes per-HLO device
     timing, H2D/D2H transfers, and fusion boundaries — the ground truth behind
-    the cost table in docs/ARCHITECTURE.md §5."""
+    the cost table in docs/ARCHITECTURE.md §5.  Pairs with the host-side
+    flight recorder (``trace=`` / ``scripts/wf_trace.py``): load both files
+    into Perfetto for device HLO timing beside the per-batch causal timeline.
+
+    One session at a time: JAX's profiler is process-global, and a nested
+    ``start_trace`` raises an opaque error from deep inside the profiler.
+    This wrapper detects the active session FIRST and raises a
+    ``RuntimeError`` that names the holder and the fix."""
+    global _xprof_logdir
     import jax
-    jax.profiler.start_trace(logdir)
+    with _xprof_lock:
+        if _xprof_logdir is not None:
+            raise RuntimeError(
+                f"xprof_trace({logdir!r}): a profiler session is already "
+                f"active, capturing to {_xprof_logdir!r} — JAX supports one "
+                f"trace per process; nest this region inside the existing "
+                f"capture (one file is enough: the trace carries every "
+                f"device event between start and stop) or close it first")
+        try:
+            jax.profiler.start_trace(logdir)
+        except RuntimeError as e:
+            # a session started OUTSIDE this wrapper (TensorBoard capture
+            # button, a direct jax.profiler.start_trace) — same root cause,
+            # same guidance, original error chained
+            raise RuntimeError(
+                f"xprof_trace({logdir!r}): jax.profiler.start_trace failed — "
+                f"most likely another profiler session (TensorBoard capture, "
+                f"a direct start_trace elsewhere in this process) is already "
+                f"active; stop it before opening a new capture") from e
+        _xprof_logdir = logdir
     try:
         yield logdir
     finally:
-        jax.profiler.stop_trace()
+        # stop BEFORE releasing the guard: clearing first would open a
+        # window where a concurrent xprof_trace passes the guard and hits
+        # JAX's still-active profiler with the raw error again
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            with _xprof_lock:
+                _xprof_logdir = None
