@@ -129,11 +129,10 @@ PEAK_TFLOPS = float(os.environ.get("WF_PEAK_TFLOPS", 197))
 
 def _arg_specs(args):
     """ShapeDtypeStruct skeleton of ``args`` — captured BEFORE a donating loop
-    runs (metadata only), usable for lowering AFTER it."""
-    import jax
-    return jax.tree.map(
-        lambda a: (jax.ShapeDtypeStruct(a.shape, a.dtype)
-                   if hasattr(a, "shape") else a), args)
+    runs (metadata only), usable for lowering AFTER it. One implementation,
+    shared with the hermetic perf gate."""
+    from windflow_tpu.analysis.perfgate import _arg_specs as impl
+    return impl(args)
 
 
 def _roofline(step_jitted, args, step_s):
@@ -149,12 +148,9 @@ def _roofline(step_jitted, args, step_s):
     the healthcheck and the measurement — if the link dies here, the
     throughput number has already landed."""
     try:
-        compiled = step_jitted.lower(*args).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0))
-        bts = float(ca.get("bytes accessed", 0.0))
+        from windflow_tpu.analysis.perfgate import _cost_of
+        cost = _cost_of(step_jitted.lower(*args).compile())
+        flops, bts = cost["flops"], cost["bytes_accessed"]
     except Exception as e:  # noqa: BLE001 — cost model is backend-dependent
         return {"error": f"cost_analysis unavailable: {e}"}
     gbps = bts / step_s / 1e9
@@ -180,20 +176,31 @@ def _roofline(step_jitted, args, step_s):
     return out
 
 
-def _chain_metrics(chain, step_s: float = None) -> dict:
+def _chain_metrics(chain, step_s: float = None, capacity: int = None) -> dict:
     """Graph-level metrics snapshot of one bench chain — attached to every
     persisted capture so BENCH_r*.json carry per-stage evidence (operator
     structure, routing, counters, service-time percentiles) instead of one
     opaque number. The cursor loop bypasses ``chain.push``, so the measured
     per-step time is fed to the entry op's Stats_Record first — the same
     attribution convention as CompiledChain.push (ONE fused program, one
-    launch sample credited to the entry op)."""
+    launch sample credited to the entry op).
+
+    ``stage_costs`` rides along: per-operator XLA cost-analysis rows
+    (flops / bytes accessed, ``analysis/perfgate.py::stage_costs``) — the
+    device-free half of the evidence, so a tunnel-down round still records
+    WHICH stage a cost change landed in."""
     from windflow_tpu.observability import MetricsRegistry
     if step_s is not None and chain.ops:
         chain.ops[0].get_StatsRecords()[0].record_launch(step_s)
     reg = MetricsRegistry("bench")
     reg.register_chain("chain", chain)
-    return reg.snapshot()
+    snap = reg.snapshot()
+    try:
+        from windflow_tpu.analysis.perfgate import stage_costs
+        snap["stage_costs"] = stage_costs(chain, capacity or BATCH)
+    except Exception as e:  # noqa: BLE001 — cost rows must never kill a capture
+        snap["stage_costs"] = [{"error": f"{type(e).__name__}: {e}"}]
+    return snap
 
 
 def _cursor_bench(chain, src, batch: int = None):
@@ -490,7 +497,7 @@ def bench_adaptive(total_batches: int = 240, base_batch: int = None):
         "capacity_switches": ctl["capacity_switches"],
         "tuning_decisions": ctl["tuning_decisions"],
         "cache_path": cache_path,
-        "metrics": _chain_metrics(pipe.chain),
+        "metrics": _chain_metrics(pipe.chain, capacity=base),
     }
 
 
@@ -1041,6 +1048,12 @@ def main():
         "unit": "tuples/s",
         "vs_baseline": round(ysb_tps / BASELINE_TPS, 3),
     }
+    if "error" not in ysb_roof:
+        # XLA logical cost per step rides in the headline so BENCH_r*.json
+        # rounds carry the device-free trajectory (bench_trend.py renders
+        # these columns; the hermetic perf gate pins the same numbers)
+        headline["cost"] = {"flops_per_step": ysb_roof["flops_per_step"],
+                            "bytes_per_step": ysb_roof["bytes_per_step"]}
     record_headline(headline)
     try:
         _secondary_benches(ysb_tps, ysb_step_s)

@@ -71,7 +71,7 @@ def bench_rows(rounds, threshold: float):
         rc = d.get("rc")
         row = {"round": n, "rc": rc, "value": None, "unit": "",
                "vs_baseline": None, "stale": False, "status": "",
-               "note": ""}
+               "note": "", "flops_per_step": None, "bytes_per_step": None}
         if parsed is None or rc not in (0, None):
             # rc=1/parsed=null rounds MUST surface — a silent skip would
             # render the failed round as "nothing happened"
@@ -82,9 +82,16 @@ def bench_rows(rounds, threshold: float):
             rows.append(row)
             continue
         value = parsed.get("value")
+        cost = parsed.get("cost") or {}
         row.update(value=value, unit=parsed.get("unit", ""),
                    vs_baseline=parsed.get("vs_baseline"),
-                   stale=bool(parsed.get("stale")))
+                   stale=bool(parsed.get("stale")),
+                   # XLA logical cost per step (bench.py headline `cost`,
+                   # the hermetic perf gate's pinned metrics): moves every
+                   # round — including tunnel-down rounds via
+                   # scripts/wf_perfgate.py — where the tps number cannot
+                   flops_per_step=cost.get("flops_per_step"),
+                   bytes_per_step=cost.get("bytes_per_step"))
         if value is None:
             row["status"] = "FAILED"
             row["note"] = "parsed record without a value"
@@ -145,14 +152,21 @@ def render_markdown(bench, multichip, threshold: float) -> str:
     lines.append("")
     lines.append("## Single-chip (`BENCH_r*.json`, `parsed` metric)")
     lines.append("")
-    lines.append("| round | status | value | unit | vs baseline | note |")
-    lines.append("|---|---|---|---|---|---|")
+    lines.append("| round | status | value | unit | vs baseline "
+                 "| Mflop/step | MB/step | note |")
+    lines.append("|---|---|---|---|---|---|---|---|")
     for r in bench:
+        mflop = (f"{r['flops_per_step'] / 1e6:.2f}"
+                 if r.get("flops_per_step") else "—")
+        mb = (f"{r['bytes_per_step'] / 1e6:.2f}"
+              if r.get("bytes_per_step") else "—")
         lines.append(f"| r{r['round']:02d} | {r['status']} "
                      f"| {_fmt(r['value'])} | {r['unit'] or '—'} "
-                     f"| {_fmt(r['vs_baseline'])} | {_cell(r['note'] or '')} |")
+                     f"| {_fmt(r['vs_baseline'])} "
+                     f"| {mflop} | {mb} | {_cell(r['note'] or '')} |")
     if not bench:
-        lines.append("| — | — | — | — | — | no BENCH_r*.json found |")
+        lines.append("| — | — | — | — | — | — | — "
+                     "| no BENCH_r*.json found |")
     lines.append("")
     lines.append("## Multi-chip smoke (`MULTICHIP_r*.json`)")
     lines.append("")

@@ -334,6 +334,42 @@ def test_wf241_unregistered_counter(tmp_path):
     assert len(hits) == 1 and "typo_counter" in hits[0].message
 
 
+def test_wf250_unregistered_kernel_name(tmp_path):
+    """Literal kernel/impl names at register_kernel/resolve_impl call sites
+    are gated against names.py::KERNELS / KERNEL_IMPLS — any spelling
+    (module function or registry method)."""
+    cfg = _mini_repo(tmp_path, '''
+        from .ops.registry import register_kernel, resolve_impl
+
+        register_kernel("good_kernel", "good_impl", reference=True)
+        register_kernel("typo_kernel", "good_impl")
+        register_kernel("good_kernel", "typo_impl")
+
+        def f(REGISTRY):
+            resolve_impl("good_kernel")
+            return REGISTRY.resolve_impl("typo_kernel2", spec_key="s")
+    ''')
+    (tmp_path / "windflow_tpu" / "observability" / "names.py").write_text(
+        _NAMES_PY + 'KERNELS = ("good_kernel",)\n'
+                    'KERNEL_IMPLS = ("good_impl",)\n')
+    hits = [x for x in lint.run_lint(cfg=cfg) if x.code == "WF250"]
+    msgs = "\n".join(x.message for x in hits)
+    assert len(hits) == 3, msgs
+    assert "typo_kernel" in msgs and "typo_impl" in msgs \
+        and "typo_kernel2" in msgs
+
+
+def test_wf250_silent_without_kernel_registry(tmp_path):
+    """A minimal tree whose names.py predates the kernel registry (no
+    KERNELS tuple) lints clean — the rule has nothing to check against."""
+    cfg = _mini_repo(tmp_path, '''
+        from .ops.registry import resolve_impl
+        def f():
+            return resolve_impl("anything_goes")
+    ''')
+    assert not [x for x in lint.run_lint(cfg=cfg) if x.code == "WF250"]
+
+
 def test_baseline_suppression_roundtrip(tmp_path):
     cfg = _mini_repo(tmp_path, '''
         def f():

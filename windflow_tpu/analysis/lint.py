@@ -24,6 +24,11 @@ WF240  error     journal event/span name not in the central registry
 WF241  error     counter/gauge name not in the central registries
                  (``RECOVERY_COUNTERS`` / ``CONTROL_COUNTERS`` /
                  ``CONTROL_GAUGES``)
+WF250  error     kernel/impl name at a ``register_kernel``/
+                 ``resolve_impl`` call site not in the central
+                 registries (``observability/names.py::KERNELS`` /
+                 ``KERNEL_IMPLS``) — a typo'd kernel name silently
+                 forks the env-override/tuning-cache/WF109 namespaces
 ====== ========= =====================================================
 
 Annotation grammar (one per physical line; for a multi-line statement the
@@ -478,12 +483,15 @@ def load_name_registries(cfg: LintConfig) -> Dict[str, frozenset]:
     path = os.path.join(cfg.root, cfg.names_file)
     wanted = {"JOURNAL_EVENTS", "RECOVERY_COUNTERS", "CONTROL_COUNTERS",
               "CONTROL_GAUGES"}
+    # optional registries (WF250): absent in minimal fixture trees — the
+    # rule then simply has nothing to check against
+    optional = {"KERNELS", "KERNEL_IMPLS"}
     regs: Dict[str, frozenset] = {}
     tree = ast.parse(open(path, encoding="utf-8").read())
     for node in tree.body:
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id in wanted):
+                and node.targets[0].id in (wanted | optional)):
             regs[node.targets[0].id] = frozenset(
                 ast.literal_eval(node.value))
     missing = wanted - set(regs)
@@ -622,6 +630,58 @@ def rule_emitted_names(cfg: LintConfig, files: List[_File]) -> List[Finding]:
     return out
 
 
+# -------------------------------------------- rule: WF250 kernel registry
+
+
+#: call names the WF250 rule inspects (module functions of ``ops/registry.py``
+#: and the ``KernelRegistry`` methods — both spellings appear at call sites)
+_KERNEL_CALLS = ("register_kernel", "resolve_impl")
+
+
+def rule_kernel_names(cfg: LintConfig, files: List[_File]) -> List[Finding]:
+    """Every LITERAL kernel name passed to ``register_kernel``/
+    ``resolve_impl`` must be in ``names.py::KERNELS`` (and a literal impl
+    name at a ``register_kernel`` site in ``KERNEL_IMPLS``) — the same
+    one-source-of-truth discipline as WF240/241, for the per-backend kernel
+    registry's selection/autotune/WF109 namespaces."""
+    regs = load_name_registries(cfg)
+    kernels = regs.get("KERNELS")
+    impls = regs.get("KERNEL_IMPLS", frozenset())
+    names_rel = cfg.names_file.replace(os.sep, "/")
+    if kernels is None:
+        return []                  # minimal tree without a kernel registry
+    out: List[Finding] = []
+    for f in files:
+        if f.tree is None or f.rel == names_rel:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            called = (fn.id if isinstance(fn, ast.Name)
+                      else (fn.attr if isinstance(fn, ast.Attribute)
+                            else None))
+            if called not in _KERNEL_CALLS:
+                continue
+            name = _const_str_arg(node)
+            if name is not None and name not in kernels:
+                out.append(f.finding(
+                    "WF250", "error", node.lineno,
+                    f"kernel {name!r} is not in {names_rel}::KERNELS — "
+                    f"register it there (env overrides, tuning-cache "
+                    f"entries, and WF109 records key on this name) or fix "
+                    f"the typo"))
+            if (called == "register_kernel" and len(node.args) > 1
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)
+                    and node.args[1].value not in impls):
+                out.append(f.finding(
+                    "WF250", "error", node.lineno,
+                    f"kernel impl {node.args[1].value!r} is not in "
+                    f"{names_rel}::KERNEL_IMPLS"))
+    return out
+
+
 # --------------------------------------------------------------- the driver
 
 
@@ -645,6 +705,7 @@ def run_lint(root: str = None, cfg: LintConfig = None) -> List[Finding]:
     findings += rule_lock_guard(cfg, files)
     findings += rule_broad_except(cfg, files)
     findings += rule_emitted_names(cfg, files)
+    findings += rule_kernel_names(cfg, files)
     return sorted(findings, key=lambda x: (x.path, x.line, x.code))
 
 

@@ -43,6 +43,12 @@ WF108  error     trace config illegal / non-deterministic under the
                  ``ids="sequence"`` under supervision — a replay after
                  restore would mint fresh ids and orphan every
                  exemplar and ring-edge flow)
+WF109  warning   kernel impl recorded at trace time disagrees with the
+                 current registry/env selection (``ops/registry.py``):
+                 a cached jitted executable keeps the impl it was
+                 traced with, so the toggle the operator thinks is
+                 active is NOT what the program runs — the bench would
+                 silently measure the same implementation twice
 ====== ========= =====================================================
 
 Usage::
@@ -373,6 +379,29 @@ def _check_trace(report, trace, stored_arg, supervised: bool) -> None:
                  "PositionBucket")
 
 
+def _check_kernel_records(report) -> None:
+    """WF109: compare every kernel-impl choice the registry recorded at
+    trace time against what it would resolve to NOW (env/tuning-cache as of
+    this call). A disagreement means some cached executable in this process
+    is running an impl the current configuration no longer selects — the
+    A/B-measured-the-same-impl-twice footgun documented at the
+    ``WF_*_IMPL`` definition sites, now detectable instead of folklore."""
+    from ..ops import registry as _registry
+    for rec in _registry.stale_selections():
+        report.add(
+            "WF109", "warning",
+            f"kernel[{rec['kernel']}]",
+            f"impl {rec['recorded']!r} was resolved at trace time (spec "
+            f"{rec['spec_key']!r}, {rec['device']}) but the registry now "
+            f"selects {rec['current']!r} — executables compiled before the "
+            f"change keep {rec['recorded']!r} for the life of the process "
+            f"(XLA caches the traced program, not the env)",
+            hint="force a retrace (fresh process / new shapes), pass impl= "
+                 "explicitly, or revert the WF_KERNEL_IMPL/alias/tuning-"
+                 "cache change; docs/ENV_FLAGS.md lists the trace-time "
+                 "flags")
+
+
 def _check_prefetch(report, prefetch: int, first_edge) -> None:
     if not prefetch or first_edge is None:
         return
@@ -647,4 +676,6 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
                    f"cannot validate a {type(obj).__name__}; expected "
                    f"PipeGraph, Pipeline, ThreadedPipeline, "
                    f"SupervisedPipeline, or CompiledChain")
+        return report
+    _check_kernel_records(report)
     return report

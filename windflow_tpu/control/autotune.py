@@ -163,9 +163,30 @@ def tuning_key(chain_sig: str, payload_sig: str, device: str) -> str:
     return h.hexdigest()[:16]
 
 
+def kernel_tuning_key(kernel: str, spec_key: str, device: str) -> str:
+    """Cache key for a per-backend KERNEL impl winner (``ops/registry.py``):
+    the same keyed-by-(signature, spec, device) discipline as the capacity
+    plans, with the kernel family name standing in for the chain signature —
+    capacity entries and kernel entries share one cache file without
+    colliding."""
+    return tuning_key(f"kernel:{kernel}", spec_key, device)
+
+
 class TuningCache:
-    """JSON file of winning plans: ``{key: {"capacity": c, "tps": r, ...}}``.
-    Read-merge-atomic-replace on ``put``; a corrupt/missing file reads empty."""
+    """JSON file of winning plans, read-merge-atomic-replace on ``put``; a
+    corrupt/missing file reads empty. Two entry kinds share the store:
+
+    - **capacity plans** (``tuning_key``): ``{"capacity": c, "tps": r,
+      "ladder": [...], "name": ...}`` — the autotuner's converged rung.
+    - **kernel impl winners** (``kernel_tuning_key``, written by
+      ``ops/registry.py::persist_winner``): ``{"impl": "pallas", "kernel":
+      "histogram", "spec": ..., "tps": ...}`` — the per-backend registry
+      warm-starts kernel selection from these, so a chain's first trace
+      already uses the best known implementation for this device.
+
+    Consumers ignore entry kinds they don't understand (``get`` returns the
+    raw dict), so the schema extension is forward- and backward-compatible.
+    """
 
     def __init__(self, path: str):
         self.path = path

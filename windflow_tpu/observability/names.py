@@ -86,3 +86,27 @@ CONTROL_COUNTERS = (
 CONTROL_GAUGES = (
     "chosen_capacity",
 )
+
+#: kernel families selectable through the per-backend kernel registry
+#: (``ops/registry.py``).  The linter (WF250) checks every literal kernel
+#: name passed to ``register_kernel``/``resolve_impl`` against this tuple —
+#: a typo'd kernel name would silently fork the selection/autotune namespace
+#: (its env overrides, tuning-cache entries, and WF109 trace records would
+#: never match the real kernel's).  The perf gate's proxy microbenchmarks
+#: also enumerate this tuple, so a registered-but-unbenchmarked kernel fails
+#: ``tests/test_perfgate.py``.
+KERNELS = (
+    "histogram",        # ops/histogram.py keyed_pane_histogram
+    "lookup",           # ops/lookup.py table_lookup (factored path)
+    "ordering_merge",   # parallel/ordering.py bitonic merge/sort network
+    "segment_fold",     # ops/segment.py segment_fold (window fold path)
+    "join_probe",       # ops/lookup.py join_probe (stream-table join)
+)
+
+#: implementation names a kernel may register under (WF250 checks literal
+#: impl names at ``register_kernel`` call sites too)
+KERNEL_IMPLS = (
+    "xla",              # reference formulation — always registered
+    "pallas",           # fused Pallas kernel (TPU; interpret mode on CPU)
+    "pallas_mm",        # histogram only: static-store matmul placement
+)

@@ -174,10 +174,15 @@ class Win_SeqFFAT(Basic_Operator):
         """Fold a batch into the [K, P] pane ring. The occupancy counts — and, for a
         count-like lift (lift(t) == 1, the YSB/windowed-count case), the partials
         themselves — go through the MXU histogram (``ops/histogram.py``) instead of a
-        serialized scatter-add; other lifts keep the segment-reduce path. Slot
-        cleanliness is maintained by clear-on-fire in ``_g_emit`` so no pane-id
-        bookkeeping is needed; OLD tuples (pane already fired) are dropped with a
-        scalar horizon compare."""
+        serialized scatter-add; other additive lifts take the segment-fold path
+        (``ops/segment.py::segment_fold``). Both are kernel-registry families
+        (``"histogram"``/``"segment_fold"``, ``ops/registry.py``) — the impl is
+        resolved at trace time per (kernel, shape spec, device), so this fold
+        call site A/Bs between XLA and the fused Pallas kernels via
+        ``WF_KERNEL_IMPL`` with no code change here. Slot cleanliness is
+        maintained by clear-on-fire in ``_g_emit`` so no pane-id bookkeeping is
+        needed; OLD tuples (pane already fired) are dropped with a scalar
+        horizon compare."""
         from ..ops.histogram import keyed_pane_histogram
         K, P = self.num_keys, self.P
         pane = batch.ts // self.pane_len
@@ -297,7 +302,9 @@ class Win_SeqFFAT(Basic_Operator):
     def _insert(self, state: FFATState, batch: Batch):
         """Lift each tuple and fold it into its (key, pane) partial: the FlatFAT
         'update leaf + bubble' (wf/flatfat.hpp:134-240) collapsed into one segment
-        reduction per batch."""
+        reduction per batch. The additive folds (values, occupancy counts) route
+        through the registry-selectable ``segment_fold`` kernel — see
+        ``_g_insert`` for the selection contract."""
         from ..ops.segment import segment_rank
         from ..ops.lookup import table_lookup
         K, P = self.num_keys, self.P

@@ -1,1 +1,4 @@
-from . import compaction, segment  # noqa: F401
+# Importing the package wires every kernel module's registration footer into
+# the per-backend registry — resolve_impl() must see the full impl table no
+# matter which op a caller reaches first.
+from . import bitonic, compaction, histogram, lookup, registry, segment  # noqa: F401

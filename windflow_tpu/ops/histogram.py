@@ -54,8 +54,10 @@ def keyed_pane_histogram(key: jax.Array, pane: jax.Array, valid: jax.Array,
 
     ``impl``: "xla" (default; the inline einsum formulation below) or "pallas"
     (:func:`keyed_pane_histogram_pallas`'s kernel as the fast branch — same
-    locality cond, same scatter fallback). Defaults from ``WF_HISTOGRAM_IMPL``
-    so a whole chain can be A/B'd without code changes.
+    locality cond, same scatter fallback). Defaults from the per-backend
+    kernel registry (``ops/registry.py``: ``WF_KERNEL_IMPL``, the deprecated
+    ``WF_HISTOGRAM_IMPL`` alias, or a persisted autotuned winner) so a whole
+    chain can be A/B'd without code changes.
     """
     C = key.shape[0]
     K, P = int(num_keys), int(ring)
@@ -107,13 +109,16 @@ def keyed_pane_histogram(key: jax.Array, pane: jax.Array, valid: jax.Array,
                                   preferred_element_type=jnp.float32)
         return out.astype(jnp.int32)
 
-    # NOTE: both env vars are read at TRACE time — a jitted executable compiled
-    # before the env change keeps the old impl for the life of the process
-    # (XLA caches the traced program, not the env). For A/B runs or tests that
-    # toggle WF_HISTOGRAM_IMPL via monkeypatch, force a retrace (fresh jit /
-    # different shapes) or pass impl= explicitly. Same caveat as WF_LOOKUP_IMPL
-    # (ops/lookup.py).
-    impl = impl or os.environ.get("WF_HISTOGRAM_IMPL", "xla")
+    # NOTE: selection (and the WF_HISTOGRAM_FORCE_FAST read below) happens at
+    # TRACE time — a jitted executable compiled before the env change keeps
+    # the old impl for the life of the process (XLA caches the traced
+    # program, not the env). The registry records this choice and validate()
+    # reports disagreements as WF109; for A/B runs force a retrace (fresh
+    # jit / different shapes) or pass impl= explicitly. The old
+    # WF_HISTOGRAM_IMPL toggle is honored as a deprecated registry alias.
+    from .registry import resolve_impl
+    impl = resolve_impl("histogram", impl=impl,
+                        spec_key=f"C{C}xK{K}xP{P}c{chunk}l{locality}")
     # '0'/empty = off — the WF_ORDERING_SKIP_SORTED convention (a bare bool()
     # of the string made '0' ENABLE the wrong-answer diagnostic bypass)
     force_fast = os.environ.get("WF_HISTOGRAM_FORCE_FAST", "0") not in ("", "0")
@@ -246,3 +251,15 @@ def _pallas_fast(key, pane, valid, K, P, chunk, locality, *,
     # fold the spill columns back onto the ring head (wrap-around completion)
     out = padded[:, :P].at[:, :L].add(padded[:, P:])
     return out.astype(jnp.int32)
+
+
+# ------------------------------------------------------------- registration
+
+from .registry import register_kernel  # noqa: E402  (registration footer)
+
+register_kernel("histogram", "xla", keyed_pane_histogram, reference=True,
+                backends=("xla",), default=True)
+register_kernel("histogram", "pallas", keyed_pane_histogram_pallas,
+                backends=("pallas-tpu", "pallas-interpret"))
+register_kernel("histogram", "pallas_mm", keyed_pane_histogram_pallas,
+                backends=("pallas-tpu", "pallas-interpret"))

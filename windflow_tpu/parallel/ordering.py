@@ -29,7 +29,12 @@ so a push never re-sorts the pool. Each push is ONE jitted dispatch that:
 3. merges it with the sorted backlog via a bitonic merge network —
    log2(pool+batch) vectorized compare-exchange stages over the composite keys
    (the reference pays O(log n) per tuple in per-key priority queues,
-   ``wf/ordering_node.hpp:79-94``; this is the data-parallel restatement),
+   ``wf/ordering_node.hpp:79-94``; this is the data-parallel restatement).
+   The network is the ``"ordering_merge"`` kernel of the per-backend registry
+   (``ops/registry.py``): ``xla`` = per-stage fused ops (``ops/bitonic.py::
+   merge_network``), ``pallas`` = all stages in ONE kernel, keys
+   VMEM-resident (``merge_network_pallas``) — resolved once per node at
+   construction, byte-identical either way,
 4. releases the provably-complete PREFIX with one elementwise compare (no sort),
 5. renumbers on device in TS_RENUMBERING mode (``_next_id`` is a device scalar).
 
@@ -101,32 +106,23 @@ def _masked_keys(mode, b: Batch, chan):
             jnp.where(v, tert, _BIG))
 
 
-def _bitonic_merge(prim, sec, chan, idx):
+def _bitonic_merge(prim, sec, chan, idx, impl: str = "xla"):
     """Merge a bitonic (ascending++descending) composite-key sequence into
     ascending order: log2(n) vectorized compare-exchange stages. ``idx`` is
     the unique position tie-break (making the order total) AND the gather
     index that moves the actual rows once at the end.
 
-    Each stride-d stage pairs i with i^d — positions that are CONTIGUOUS
-    under a [n/(2d), 2, d] reshape (element [k, j, m] is index k*2d + j*d + m,
-    so slots j=0/j=1 differ exactly in bit d). Expressing the butterfly as
-    reshape + elementwise select instead of a pos^d gather is 77x faster on
-    the CPU backend (0.28 ms vs 21.7 ms at n=8192) and 3x faster to compile —
-    XLA fuses slicing/wheres but lowers dynamic gathers to scalar loops."""
-    n = prim.shape[0]
-    arrs = [prim, sec, chan, idx]
-    d = n // 2
-    while d >= 1:
-        rs = [a.reshape(n // (2 * d), 2, d) for a in arrs]
-        lt = _lex_lt(tuple(r[:, 0] for r in rs), tuple(r[:, 1] for r in rs))
-
-        def sel(r):
-            lo = jnp.where(lt, r[:, 0], r[:, 1])
-            hi = jnp.where(lt, r[:, 1], r[:, 0])
-            return jnp.stack([lo, hi], axis=1).reshape(n)
-        arrs = [sel(r) for r in rs]
-        d //= 2
-    return tuple(arrs)
+    The network itself lives in ``ops/bitonic.py`` (the ``"ordering_merge"``
+    registry kernel): ``impl="xla"`` is the per-stage reshape+select form
+    (77x faster than a pos^d gather on the CPU backend — 0.28 ms vs 21.7 ms
+    at n=8192; XLA fuses slicing/wheres but lowers dynamic gathers to scalar
+    loops), ``impl="pallas"`` fuses ALL stages into one kernel whose key
+    arrays never leave VMEM. Byte-identical by construction — both run the
+    same compare-exchange plan."""
+    from ..ops import bitonic
+    merge = (bitonic.merge_network_pallas if impl == "pallas"
+             else bitonic.merge_network)
+    return merge(prim, sec, chan, idx)
 
 
 def _wm_after(mode, wm, channel, batch: Batch):
@@ -181,7 +177,7 @@ def _split_release(mode, sortedb: Batch, chan_s, wm, next_id,
     return out, kept, kept_chan, counts, next_id
 
 
-def _sort_batch(mode, batch: Batch, chan):
+def _sort_batch(mode, batch: Batch, chan, merge_impl: str = "xla"):
     """Stable ascending sort of one batch by the composite key (invalid to
     the tail). Returns (sorted keys..., data-order permutation).
 
@@ -201,8 +197,17 @@ def _sort_batch(mode, batch: Batch, chan):
     lexsort) — the same diagnostic pattern as WF_HISTOGRAM_FORCE_FAST."""
     import os
     bp, bs, bc = _masked_keys(mode, batch, chan)
+    C = batch.capacity
 
     def dosort(_):
+        if merge_impl == "pallas" and C >= 2 and C & (C - 1) == 0:
+            # fused bitonic SORT network (ops/bitonic.py): the unique iota
+            # tie-break makes the composite key total, so the network output
+            # IS the stable lexsort permutation — byte-identical impls
+            from ..ops.bitonic import sort_network_pallas
+            iota = jnp.arange(C, dtype=jnp.int32)
+            sp, ss, sc, order = sort_network_pallas(bp, bs, bc, iota)
+            return sp, ss, sc, order
         order = jnp.lexsort((bc, bs, bp)).astype(jnp.int32)
         return bp[order], bs[order], bc[order], order
 
@@ -217,22 +222,24 @@ def _sort_batch(mode, batch: Batch, chan):
     return jax.lax.cond(jnp.all(asc), ident, dosort, None)
 
 
-def _first_push_core(mode, batch: Batch, channel, wm, next_id):
+def _first_push_core(mode, merge_impl, batch: Batch, channel, wm, next_id):
     """First push: no backlog — sort the batch, release the prefix."""
     wm = _wm_after(mode, wm, channel, batch)
     chan = jnp.full((batch.capacity,), channel, CTRL_DTYPE)
-    _, _, _, order = _sort_batch(mode, batch, chan)
+    _, _, _, order = _sort_batch(mode, batch, chan, merge_impl)
     sortedb = batch.select(order, jnp.ones_like(batch.valid))
     out, kept, kept_chan, counts, next_id = _split_release(
         mode, sortedb, chan, wm, next_id, False)
     return out, kept, kept_chan, counts, wm, next_id
 
 
-def _push_core(mode, pending: Batch, pchan, batch: Batch, channel, wm,
-               next_id):
+def _push_core(mode, merge_impl, pending: Batch, pchan, batch: Batch,
+               channel, wm, next_id):
     """The per-push hot path, one dispatch: watermark update + incoming-batch
     sort + bitonic merge with the sorted backlog + prefix release +
-    renumbering."""
+    renumbering. ``merge_impl`` (trace-time, resolved by the node through
+    the kernel registry) routes the merge/sort networks: "xla" = per-stage
+    fused ops, "pallas" = one kernel, keys VMEM-resident for all stages."""
     wm = _wm_after(mode, wm, channel, batch)
     P, B = pending.capacity, batch.capacity
     N = 1
@@ -241,7 +248,7 @@ def _push_core(mode, pending: Batch, pchan, batch: Batch, channel, wm,
     ap, asec, ac = _masked_keys(mode, pending, pchan)      # ascending already
     aidx = jnp.arange(P, dtype=jnp.int32)
     bchan = jnp.full((B,), channel, CTRL_DTYPE)
-    bp, bs, bc, border = _sort_batch(mode, batch, bchan)
+    bp, bs, bc, border = _sort_batch(mode, batch, bchan, merge_impl)
     bidx = P + border
     # pad the B side to N - P with +inf keys / garbage index, then reverse:
     # ascending(A) ++ descending(B) is bitonic for any split point
@@ -252,7 +259,7 @@ def _push_core(mode, pending: Batch, pchan, batch: Batch, channel, wm,
     sec = jnp.concatenate([asec, ext(bs, _BIG)])
     chn = jnp.concatenate([ac, ext(bc, _BIG)])
     idx = jnp.concatenate([aidx, ext(bidx, P + B)])
-    _, _, _, idx = _bitonic_merge(prim, sec, chn, idx)
+    _, _, _, idx = _bitonic_merge(prim, sec, chn, idx, merge_impl)
     # one gather moves the rows: concat(pending, batch, 1 invalid garbage row)
     def take2(a, b):
         z = jnp.zeros((1,) + a.shape[1:], a.dtype)
@@ -274,21 +281,29 @@ def _push_core(mode, pending: Batch, pchan, batch: Batch, channel, wm,
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_cores(mode: ordering_mode_t):
-    """One (push, first_push, release) jit triple per mode, shared by every
-    Ordering_Node instance — construction of a fresh node/graph re-traces
-    nothing."""
-    push = jax.jit(functools.partial(_push_core, mode))
-    first = jax.jit(functools.partial(_first_push_core, mode))
+def _jitted_cores(mode: ordering_mode_t, merge_impl: str = "xla"):
+    """One (push, first_push, release) jit triple per (mode, merge impl),
+    shared by every Ordering_Node instance — construction of a fresh
+    node/graph re-traces nothing. ``merge_impl`` is part of the cache key:
+    the impl is baked into the traced program (the WF109 trace-time
+    contract), so two impls coexist as two executables, never a retrace."""
+    push = jax.jit(functools.partial(_push_core, mode, merge_impl))
+    first = jax.jit(functools.partial(_first_push_core, mode, merge_impl))
     release = jax.jit(functools.partial(_split_release, mode),
                       static_argnums=(4,))
     return push, first, release
 
 
 class Ordering_Node:
-    def __init__(self, n_inputs: int, mode: ordering_mode_t = ordering_mode_t.TS):
+    def __init__(self, n_inputs: int, mode: ordering_mode_t = ordering_mode_t.TS,
+                 merge_impl: str = None):
+        from ..ops.registry import resolve_impl
         self.n_inputs = int(n_inputs)
         self.mode = mode
+        # kernel-registry selection at CONSTRUCTION time (= trace time for
+        # the shared jitted cores); recorded for the WF109 staleness check
+        self.merge_impl = resolve_impl("ordering_merge", impl=merge_impl,
+                                       spec_key=f"mode={mode.name}")
         self._wm_dev = jnp.full((self.n_inputs,), WM_NONE, CTRL_DTYPE)
         self._pending: Optional[Batch] = None    # INVARIANT: sorted, invalid at tail
         self._pending_chan = None                # i32[C] source channel per lane
@@ -299,7 +314,7 @@ class Ordering_Node:
         #: call returns None (no stale value survives a no-release call).
         self.last_release_count = 0
         self._push_jit, self._first_push_jit, self._release_jit = \
-            _jitted_cores(mode)
+            _jitted_cores(mode, self.merge_impl)
 
     # -- host protocol ----------------------------------------------------------------
 
