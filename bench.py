@@ -501,6 +501,48 @@ def bench_adaptive(total_batches: int = 240, base_batch: int = None):
     }
 
 
+def bench_dispatch(total_batches: int = 96, base_batch: int = None,
+                   k: int = None):
+    """Scan dispatch through the real Pipeline driver: the SAME chain driven
+    per-batch (dispatch off) and K-fused (``dispatch=k``), launch counts read
+    from the entry op's own Stats_Record (``num_kernels`` vs
+    ``batches_received`` — the attribution CompiledChain.push_many makes: K
+    batches, ONE kernel). The dispatch-amortization evidence next to the
+    throughput it buys; ``launches_per_batch`` rides in the headline so
+    ``bench_trend.py``'s launches/step column moves every round."""
+    import jax.numpy as jnp
+    import windflow_tpu as wf
+    from windflow_tpu.operators.source import DeviceSource
+
+    base = base_batch or max(BATCH // 4, 1 << 12)
+    k = k or int(os.environ.get("WF_DISPATCH_K", "8") or "8")
+
+    def run(dispatch):
+        src = DeviceSource(lambda i: {"v": (i % 1000).astype(jnp.float32)},
+                           total=total_batches * base, num_keys=512)
+        pipe = wf.Pipeline(src, [wf.Map(lambda t: {"v": t.v * 2.0 + 1.0}),
+                                 wf.Filter(lambda t: t.v > 100.0),
+                                 wf.ReduceSink(lambda t: t.v)],
+                           batch_size=base, dispatch=dispatch)
+        t0 = time.perf_counter()
+        pipe.run()
+        dt = time.perf_counter() - t0
+        rec = pipe.chain.ops[0].get_StatsRecords()[0]
+        return {"tps": round(total_batches * base / dt),
+                "batches": rec.batches_received,
+                "launches": rec.num_kernels,
+                "launches_per_batch": round(rec.num_kernels
+                                            / max(rec.batches_received, 1), 4)}
+
+    fused = run(k)
+    per_batch = run(False)
+    return {
+        "dispatch_k": k, "base_capacity": base,
+        "fused": fused, "per_batch": per_batch,
+        "speedup": round(fused["tps"] / max(per_batch["tps"], 1), 3),
+    }
+
+
 def bench_keyed_stateful(num_keys: int):
     """MapGPU-stateful analogue (BASELINE.md rows 3-5): keyed map with a per-key
     running state folded in stream order (the reference keeps a per-key device
@@ -1056,7 +1098,7 @@ def main():
                             "bytes_per_step": ysb_roof["bytes_per_step"]}
     record_headline(headline)
     try:
-        _secondary_benches(ysb_tps, ysb_step_s)
+        _secondary_benches(ysb_tps, ysb_step_s, headline)
     except Exception as e:  # noqa: BLE001 — keep the fresh headline
         import traceback
         traceback.print_exc()
@@ -1079,13 +1121,30 @@ def capture_stateless_isolated():
     return sl_tps, sl_step_s, sl_roof
 
 
-def _secondary_benches(ysb_tps, ysb_step_s):
+def _secondary_benches(ysb_tps, ysb_step_s, headline=None):
     sl_tps, sl_step_s, sl_roof = capture_stateless_isolated()
     print(f"YSB: {ysb_tps/1e6:.2f} M tuples/s ({ysb_step_s*1e3:.2f} ms/step, "
           f"batch={BATCH})", file=sys.stderr)
     print(f"stateless map+filter: {sl_tps/1e6:.2f} M tuples/s "
           f"({sl_step_s*1e3:.2f} ms/step; roofline "
           f"{sl_roof.get('hbm_utilization_pct', '?')}% HBM)", file=sys.stderr)
+    # scan dispatch: driver-level, so it runs isolated like the other driver
+    # benches; its launches/batch number ALSO rides the headline `dispatch`
+    # record (re-persisted) so BENCH_r*.json rounds carry the
+    # dispatch-amortization trajectory next to the cost columns
+    dd = _run_isolated("bench_dispatch()")
+    record("dispatch", dd, methodology="isolated-subprocess")
+    if headline is not None:
+        headline["dispatch"] = {
+            "k": dd["dispatch_k"],
+            "launches_per_step": dd["fused"]["launches_per_batch"],
+        }
+        record_headline(headline)
+    print(f"scan dispatch (K={dd['dispatch_k']}): "
+          f"{dd['fused']['tps']/1e6:.2f} M tuples/s fused "
+          f"({dd['fused']['launches_per_batch']:.3f} launches/batch) vs "
+          f"{dd['per_batch']['tps']/1e6:.2f} M per-batch "
+          f"({dd['speedup']:.2f}x)", file=sys.stderr)
     kc_tps, kc_step, kc_roof, kc_metrics = _run_isolated("bench_keyed_cb()")
     record("keyed_cb", {"tps": kc_tps, "step_s": kc_step, "roofline": kc_roof,
                         "metrics": kc_metrics},

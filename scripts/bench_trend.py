@@ -71,7 +71,8 @@ def bench_rows(rounds, threshold: float):
         rc = d.get("rc")
         row = {"round": n, "rc": rc, "value": None, "unit": "",
                "vs_baseline": None, "stale": False, "status": "",
-               "note": "", "flops_per_step": None, "bytes_per_step": None}
+               "note": "", "flops_per_step": None, "bytes_per_step": None,
+               "launches_per_step": None}
         if parsed is None or rc not in (0, None):
             # rc=1/parsed=null rounds MUST surface — a silent skip would
             # render the failed round as "nothing happened"
@@ -83,6 +84,7 @@ def bench_rows(rounds, threshold: float):
             continue
         value = parsed.get("value")
         cost = parsed.get("cost") or {}
+        dispatch = parsed.get("dispatch") or {}
         row.update(value=value, unit=parsed.get("unit", ""),
                    vs_baseline=parsed.get("vs_baseline"),
                    stale=bool(parsed.get("stale")),
@@ -91,7 +93,11 @@ def bench_rows(rounds, threshold: float):
                    # round — including tunnel-down rounds via
                    # scripts/wf_perfgate.py — where the tps number cannot
                    flops_per_step=cost.get("flops_per_step"),
-                   bytes_per_step=cost.get("bytes_per_step"))
+                   bytes_per_step=cost.get("bytes_per_step"),
+                   # scan dispatch (bench.py headline `dispatch`): host
+                   # executable launches per batch through the real driver —
+                   # 1.0 per-batch, ~1/K fused (bench_dispatch)
+                   launches_per_step=dispatch.get("launches_per_step"))
         if value is None:
             row["status"] = "FAILED"
             row["note"] = "parsed record without a value"
@@ -153,19 +159,21 @@ def render_markdown(bench, multichip, threshold: float) -> str:
     lines.append("## Single-chip (`BENCH_r*.json`, `parsed` metric)")
     lines.append("")
     lines.append("| round | status | value | unit | vs baseline "
-                 "| Mflop/step | MB/step | note |")
-    lines.append("|---|---|---|---|---|---|---|---|")
+                 "| Mflop/step | MB/step | launches/step | note |")
+    lines.append("|---|---|---|---|---|---|---|---|---|")
     for r in bench:
         mflop = (f"{r['flops_per_step'] / 1e6:.2f}"
                  if r.get("flops_per_step") else "—")
         mb = (f"{r['bytes_per_step'] / 1e6:.2f}"
               if r.get("bytes_per_step") else "—")
+        lps = (f"{r['launches_per_step']:g}"
+               if r.get("launches_per_step") else "—")
         lines.append(f"| r{r['round']:02d} | {r['status']} "
                      f"| {_fmt(r['value'])} | {r['unit'] or '—'} "
                      f"| {_fmt(r['vs_baseline'])} "
-                     f"| {mflop} | {mb} | {_cell(r['note'] or '')} |")
+                     f"| {mflop} | {mb} | {lps} | {_cell(r['note'] or '')} |")
     if not bench:
-        lines.append("| — | — | — | — | — | — | — "
+        lines.append("| — | — | — | — | — | — | — | — "
                      "| no BENCH_r*.json found |")
     lines.append("")
     lines.append("## Multi-chip smoke (`MULTICHIP_r*.json`)")

@@ -15,8 +15,15 @@ the fault-free controlled baseline byte-for-byte; backpressure governor on
 the threaded driver). Controller + injection must neither diverge nor
 livelock the supervisor's backoff.
 
+--dispatch K runs every CHAOS run with scan dispatch (K-fused push_many)
+while the fault-free baselines stay per-batch — asserting the dispatch
+byte-identity claim and the recovery machinery in one sweep. The graph_det
+driver (DETERMINISTIC merge) keeps the Ordering_Node's async counts
+readback in every sweep, dispatch or not.
+
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --total 400
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --controller
+    JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --dispatch 4
 """
 
 import argparse
@@ -64,7 +71,7 @@ def collect(acc):
     return cb
 
 
-def run_pipeline(total, batch, faults=None, controller=False):
+def run_pipeline(total, batch, faults=None, controller=False, dispatch=False):
     got = []
     src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
                     total=total, num_keys=4)
@@ -73,15 +80,18 @@ def run_pipeline(total, batch, faults=None, controller=False):
     SupervisedPipeline(src, [op], wf.Sink(collect(got)), batch_size=batch,
                        checkpoint_every=3, max_restarts=8,
                        backoff_base=0.001, backoff_cap=0.01,
-                       faults=faults,
+                       faults=faults, dispatch=dispatch,
                        control=sup_control(batch) if controller else False
                        ).run()
     return sorted(got)
 
 
-def run_graph(total, batch, faults=None, controller=False):
+def run_graph(total, batch, faults=None, controller=False, dispatch=False,
+              mode=None):
+    from windflow_tpu.basic import Mode
     got = []
-    g = PipeGraph("sweep", batch_size=batch)
+    g = PipeGraph("sweep", batch_size=batch,
+                  mode=mode or Mode.DEFAULT, dispatch=dispatch)
     a = g.add_source(wf.Source(lambda i: {"v": (i % 9).astype(jnp.float32)},
                                total=total, num_keys=3, name="a"))
     b = g.add_source(wf.Source(lambda i: {"v": (i % 7).astype(jnp.float32)},
@@ -96,7 +106,18 @@ def run_graph(total, batch, faults=None, controller=False):
     return sorted(got)
 
 
-def run_threaded(total, batch, faults=None, controller=False):
+def run_graph_det(total, batch, faults=None, controller=False,
+                  dispatch=False):
+    # DETERMINISTIC merge: every root push drives the Ordering_Node's
+    # async [n_released, n_kept] readback — the sync-free hot path under
+    # chaos (and under fused dispatch when --dispatch is on)
+    from windflow_tpu.basic import Mode
+    return run_graph(total, batch, faults=faults, controller=controller,
+                     dispatch=dispatch, mode=Mode.DETERMINISTIC)
+
+
+def run_threaded(total, batch, faults=None, controller=False,
+                 dispatch=False):
     got = []
     src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=total)
     ThreadedPipeline(src, [[wf.Map(lambda t: {"v": t.v * 3})],
@@ -106,7 +127,7 @@ def run_threaded(total, batch, faults=None, controller=False):
                              np.asarray(v["payload"]["v"]).tolist()))
                          if v is not None else None),
                      batch_size=batch, pin=False, heartbeat_timeout=0.25,
-                     faults=faults,
+                     faults=faults, dispatch=dispatch,
                      control=thr_control() if controller else False).run()
     return sorted(got)
 
@@ -131,10 +152,15 @@ def main():
                     help="run every driver with the adaptive control plane "
                     "active (admission/backpressure; baselines use the same "
                     "controller, so shedding must stay deterministic)")
+    ap.add_argument("--dispatch", type=int, default=0, metavar="K",
+                    help="run every CHAOS run with scan dispatch (K-fused "
+                    "push_many) while the baselines stay per-batch — the "
+                    "fused path must match the per-batch fault-free oracle "
+                    "byte-for-byte")
     args = ap.parse_args()
 
     drivers = {"pipeline": run_pipeline, "graph": run_graph,
-               "threaded": run_threaded}
+               "graph_det": run_graph_det, "threaded": run_threaded}
     baselines = {}
     for name, fn in drivers.items():
         t0 = time.time()
@@ -150,7 +176,8 @@ def main():
             t0 = time.time()
             try:
                 out = fn(args.total, args.batch, faults=inj,
-                         controller=args.controller)
+                         controller=args.controller,
+                         dispatch=args.dispatch)   # 0 = off (every driver)
             except Exception as e:          # noqa: BLE001
                 print(f"[seed {seed}] {name}: RUN FAILED {type(e).__name__}: "
                       f"{e} ({len(inj.fired)} faults injected)")

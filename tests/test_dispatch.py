@@ -1,0 +1,608 @@
+"""Scan dispatch (runtime/dispatch.py + CompiledChain.push_many): K batches
+fused into ONE compiled lax.scan program are byte-identical to K sequential
+pushes across all four drivers — including under FaultPlan restart with
+mid-accumulator checkpoints, partial tails < K at EOS, the K=1 degenerate
+rung, and the rebatcher interaction — and the Ordering_Node's async counts
+readback preserves every release byte-for-byte."""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu import control as wfcontrol
+from windflow_tpu.basic import Mode, win_type_t
+from windflow_tpu.batch import stack_batches, unstack_batches
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.runtime import faults as faults_mod
+from windflow_tpu.runtime.dispatch import (DispatchConfig,
+                                           MicrobatchAccumulator,
+                                           build_k_ladder)
+from windflow_tpu.runtime.faults import FaultPlan, FaultSpec
+from windflow_tpu.runtime.pipegraph import PipeGraph
+from windflow_tpu.runtime.pipeline import CompiledChain
+from windflow_tpu.runtime.supervisor import (SupervisedPipeline,
+                                             run_graph_supervised)
+from windflow_tpu.runtime.threaded import ThreadedPipeline
+
+from test_mp_matrix import CASES, K as MP_K, TOTAL as MP_TOTAL  # noqa: F401
+
+TOTAL, NKEYS = 240, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults_mod.set_active(None)
+    faults_mod.reset_counters()
+    wfcontrol.reset()
+    yield
+    faults_mod.set_active(None)
+    wfcontrol.reset()
+
+
+def mk_source(total=TOTAL, name="src"):
+    return wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+                     total=total, num_keys=NKEYS, name=name)
+
+
+def collect(acc):
+    def cb(view):
+        if view is None:
+            return
+        acc.extend(zip(view["id"].tolist(),
+                       np.asarray(view["payload"]["v"]).tolist()))
+    return cb
+
+
+def win_collect(acc):
+    def cb(view):
+        if view is None:
+            return
+        acc.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                       np.asarray(view["payload"]).tolist()))
+    return cb
+
+
+# ------------------------------------------------------- stack / unstack
+
+
+def test_stack_unstack_roundtrip_byte_exact():
+    batches = list(mk_source(64).batches(16))
+    stacked = stack_batches(batches)
+    assert jax.tree.leaves(stacked)[0].shape[0] == len(batches)
+    back = unstack_batches(stacked)
+    assert len(back) == len(batches)
+    for a, b in zip(batches, back):
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_stack_batches_rejects_mixed_capacity_and_empty():
+    b16 = next(iter(mk_source(32).batches(16)))
+    b8 = next(iter(mk_source(32).batches(8)))
+    with pytest.raises(ValueError, match="mixed capacities"):
+        stack_batches([b16, b8])
+    with pytest.raises(ValueError, match="at least one"):
+        stack_batches([])
+
+
+# ----------------------------------------------------------- accumulator
+
+
+class _FakeBatch:
+    def __init__(self, capacity):
+        self.capacity = capacity
+
+
+def test_accumulator_groups_by_k_and_flushes_on_capacity_switch():
+    acc = MicrobatchAccumulator(3)
+    out = []
+    for _ in range(5):
+        out += acc.feed(_FakeBatch(16))
+    assert [len(g) for g in out] == [3]
+    # capacity switch flushes the partial run FIRST, then buffers the new
+    groups = acc.feed(_FakeBatch(8))
+    assert [len(g) for g in groups] == [2]
+    assert [b.capacity for b in groups[0]] == [16, 16]
+    assert len(acc) == 1
+    assert [b.capacity for b in acc.drain()] == [8]
+    assert acc.drain() == []
+
+
+def test_accumulator_linger_and_set_k_fake_clock():
+    now = {"t": 0.0}
+    acc = MicrobatchAccumulator(4, linger_s=0.5, clock=lambda: now["t"])
+    assert not acc.expired()
+    acc.feed(_FakeBatch(16))
+    assert not acc.expired()
+    now["t"] = 0.6
+    assert acc.expired()
+    assert len(acc.take()) == 1
+    assert not acc.expired()          # empty: never expired
+    acc.set_k(2)
+    assert acc.feed(_FakeBatch(16)) == []
+    assert len(acc.feed(_FakeBatch(16))[0]) == 2
+    acc.feed(_FakeBatch(16))
+    acc.clear()
+    assert len(acc) == 0 and acc.drain() == []
+
+
+def test_dispatch_config_resolve_forms(monkeypatch):
+    monkeypatch.delenv("WF_DISPATCH", raising=False)
+    monkeypatch.delenv("WF_DISPATCH_K", raising=False)
+    assert DispatchConfig.resolve(None) is None
+    assert DispatchConfig.resolve(False) is None
+    assert DispatchConfig.resolve(0) is None      # int 0 == the '0' spelling
+    assert DispatchConfig.resolve(True).k == 8
+    assert DispatchConfig.resolve(6).k == 6
+    assert DispatchConfig.resolve({"k": 3, "linger_s": 0.0}).linger_s == 0.0
+    cfg = DispatchConfig(k=5)
+    assert DispatchConfig.resolve(cfg) is cfg
+    monkeypatch.setenv("WF_DISPATCH", "0")
+    assert DispatchConfig.resolve(None) is None
+    monkeypatch.setenv("WF_DISPATCH", "4")
+    assert DispatchConfig.resolve(None).k == 4
+    monkeypatch.setenv("WF_DISPATCH", json.dumps({"k": 2, "prewarm": False}))
+    r = DispatchConfig.resolve(None)
+    assert (r.k, r.prewarm) == (2, False)
+    monkeypatch.setenv("WF_DISPATCH", "1")
+    monkeypatch.setenv("WF_DISPATCH_K", "16")
+    assert DispatchConfig.resolve(None).k == 16
+    assert DispatchConfig.resolve(4).k == 16      # K env wins whenever on
+    with pytest.raises(ValueError):
+        DispatchConfig(k=0)
+    with pytest.raises(ValueError):
+        DispatchConfig(linger_s=-1)
+
+
+def test_build_k_ladder():
+    assert build_k_ladder(1) == [1]
+    assert build_k_ladder(8) == [1, 2, 4, 8]
+    assert build_k_ladder(6) == [1, 2, 4, 6]
+    with pytest.raises(ValueError):
+        build_k_ladder(0)
+
+
+# ------------------------------------------------------------- push_many
+
+
+def _win_ops():
+    return [wf.Map(lambda t: {"v": t.v * 2.0}),
+            wf.Win_Seq(lambda wid, it: it.sum("v"),
+                       WindowSpec(10, 10, win_type_t.TB), num_keys=NKEYS)]
+
+
+def _batches_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_push_many_byte_identical_to_sequential_push():
+    src = mk_source(128)
+    seq = CompiledChain(_win_ops(), src.payload_spec(), batch_capacity=16)
+    fused = CompiledChain(_win_ops(), src.payload_spec(), batch_capacity=16)
+    batches = list(mk_source(128).batches(16))
+    outs_seq = [seq.push(b) for b in batches]
+    outs_fused = fused.push_many(batches)
+    assert len(outs_fused) == len(outs_seq)
+    for a, b in zip(outs_seq, outs_fused):
+        _batches_equal(a, b)
+    for sa, sb in zip(seq.states, fused.states):
+        _batches_equal(sa, sb)
+    # K=1 degenerates to push (same executable, same path)
+    one = fused.push_many([batches[0]])
+    assert len(one) == 1
+
+
+def test_push_many_stats_k_batches_one_kernel():
+    src = mk_source(96)
+    chain = CompiledChain(_win_ops(), src.payload_spec(), batch_capacity=16)
+    batches = list(mk_source(96).batches(16))
+    chain.push_many(batches)
+    rec = chain.ops[0].get_StatsRecords()[0]
+    assert rec.batches_received == len(batches)
+    assert rec.num_kernels == 1               # ONE launch for K batches
+    assert rec.bytes_received > 0
+
+
+def test_warm_scan_touches_no_state():
+    src = mk_source(64)
+    chain = CompiledChain(_win_ops(), src.payload_spec(), batch_capacity=16)
+    before = [jax.tree.map(np.asarray, s) for s in chain.states]
+    chain.warm_scan(4, 16)
+    chain.warm_scan(1, 16)                    # degenerate delegates to warm
+    for a, b in zip(before, chain.states):
+        _batches_equal(a, b)
+    assert ("scan", 0) in chain._steps
+
+
+# ------------------------------------------------------- Pipeline driver
+
+
+def _run_pipeline(dispatch=None, total=TOTAL, batch=16, **kw):
+    got = []
+    wf.Pipeline(mk_source(total), [wf.Map(lambda t: {"v": t.v * 3.0}),
+                                   wf.Win_Seq(lambda wid, it: it.sum("v"),
+                                              WindowSpec(12, 6, win_type_t.CB),
+                                              num_keys=NKEYS)],
+                wf.Sink(win_collect(got)), batch_size=batch,
+                dispatch=dispatch, **kw).run()
+    return got
+
+
+def test_pipeline_dispatch_byte_identical_with_partial_tail():
+    plain = _run_pipeline(None)
+    # 15 batches at K=4: three full groups + a 3-batch tail at EOS
+    assert _run_pipeline(4) == plain
+    assert _run_pipeline(1) == plain          # K=1 degenerate pass-through
+    # one giant partial group (prewarm off: the K=32 executable would be
+    # traced but never run — the stream holds only 15 batches)
+    assert _run_pipeline({"k": 32, "prewarm": False}) == plain
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_mp_matrix_case_dispatch_byte_identical(name):
+    def run(dispatch):
+        src = wf.Source(lambda i: {"v": ((i * 13) % 23).astype(jnp.float32)},
+                        total=MP_TOTAL, num_keys=MP_K)
+        results = []
+
+        def cb(view):
+            if view is None:
+                return
+            for k, w, r in zip(view["key"].tolist(), view["id"].tolist(),
+                               np.asarray(view["payload"]).tolist()):
+                results.append((k, w, round(float(r), 3)))
+        ops = CASES[name]()
+        if not isinstance(ops, (list, tuple)):
+            ops = [ops]
+        wf.Pipeline(src, list(ops), wf.Sink(cb), batch_size=40,
+                    dispatch=dispatch).run()
+        return results
+
+    assert run(3) == run(None)
+
+
+def test_pipeline_dispatch_with_rebatcher_byte_identical(tmp_path):
+    cfg = wf.ControlConfig(autotune=True, ladder_up=1, ladder_down=1,
+                           decide_every=4, settle_batches=1,
+                           cache_path=str(tmp_path / "tuning.json"))
+    plain = _run_pipeline(None)
+    got = _run_pipeline(4, control=cfg)
+    # capacity rungs are wall-clock hill-climb decisions, so WHERE the
+    # rebatcher re-slices (and therefore which batch a window fires in —
+    # the sink interleaving) is not replay-pinned between two runs; the
+    # window RESULTS are lane-exact invariant (the PR 3 contract), and the
+    # accumulator flushes short at every capacity switch rather than mix
+    # shapes
+    assert sorted(got) == sorted(plain)
+    # the K tuner rode along: its gauge is published
+    assert wfcontrol.gauges().get("dispatch_k") is not None
+
+
+def test_pipeline_dispatch_ysb_all_subsystems(tmp_path):
+    from windflow_tpu.benchmarks import ysb
+    ysb_total = 3000
+
+    def run(dispatch, **kw):
+        results = []
+
+        def cb(view):
+            if view is None:
+                return
+            for k, w, c in zip(view["key"].tolist(), view["id"].tolist(),
+                               np.asarray(view["payload"]).tolist()):
+                results.append((int(k), int(w), int(c)))
+        wf.Pipeline(ysb.make_source(ysb_total), ysb.make_ops(),
+                    wf.Sink(cb), batch_size=256, dispatch=dispatch,
+                    **kw).run()
+        return results
+
+    plain = run(None)
+    assert sum(c for _, _, c in plain) == ysb.oracle_totals(ysb_total)
+    cfg = wf.ControlConfig(autotune=False, admission=True,
+                           refill_per_batch=10**9)
+    got = run(4, monitoring=str(tmp_path / "mon"),
+              trace=str(tmp_path / "tr"), control=cfg)
+    assert got == plain
+    # the fused launches journaled (sampled at launch 2 with >= 2 groups)
+    events = [json.loads(ln)
+              for ln in open(tmp_path / "mon" / "events.jsonl")]
+    fused = [e for e in events if e.get("event") == "dispatch_fused"]
+    assert fused and all(e["k"] > 1 for e in fused)
+
+
+def test_pipeline_dispatch_trace_ids_identical(tmp_path):
+    from windflow_tpu.observability import tracing
+
+    def ids(dispatch, d):
+        _run_pipeline(dispatch, trace=str(tmp_path / d))
+        recs, _ = tracing.load_flight(str(tmp_path / d))
+        return ([r["tid"] for r in recs if r["kind"] == "ingest"],
+                sorted({(r["tid"], r["kind"], r["stage"]) for r in recs
+                        if r["stage"] == "chain"}))
+
+    plain_ids, plain_spans = ids(None, "off")
+    fused_ids, fused_spans = ids(4, "on")
+    assert fused_ids == plain_ids             # minted at ingest, positional
+    assert fused_spans == plain_spans         # per-batch spans synthesized
+
+
+# ------------------------------------------------------- threaded driver
+
+
+def _run_threaded(dispatch=None, **kw):
+    got = []
+    ThreadedPipeline(mk_source(480),
+                     [[wf.Map(lambda t: {"v": t.v * 3})],
+                      [wf.Map(lambda t: {"v": t.v + 1})]],
+                     wf.Sink(collect(got)), batch_size=16, pin=False,
+                     dispatch=dispatch, **kw).run()
+    return got
+
+
+def test_threaded_dispatch_byte_identical():
+    plain = _run_threaded(None)
+    assert sorted(_run_threaded(4)) == sorted(plain)
+    # generous linger: groups mostly fill; tiny linger: mostly flush short —
+    # results identical either way
+    assert sorted(_run_threaded({"k": 4, "linger_s": 0.0})) == sorted(plain)
+
+
+def test_threaded_dispatch_under_fault_drain():
+    plain = _run_threaded(None)
+    got = []
+    plan = FaultPlan([FaultSpec("queue.stall", kind="stall", stall_s=0.3,
+                                where={"stage": "seg0", "pos": 3})])
+    ThreadedPipeline(mk_source(480),
+                     [[wf.Map(lambda t: {"v": t.v * 3})],
+                      [wf.Map(lambda t: {"v": t.v + 1})]],
+                     wf.Sink(collect(got)), batch_size=16, pin=False,
+                     dispatch=4, faults=plan).run()
+    assert sorted(got) == sorted(plain)
+
+
+# ------------------------------------------------------- PipeGraph driver
+
+
+def _graph(win_sink, plain_sink, mode=Mode.DEFAULT, **kw):
+    g = PipeGraph("disp", batch_size=40, mode=mode, **kw)
+    a = g.add_source(wf.Source(lambda i: {"v": (i % 9).astype(jnp.float32)},
+                               total=TOTAL, num_keys=NKEYS, name="a"))
+    b = g.add_source(wf.Source(lambda i: {"v": (i % 7).astype(jnp.float32)},
+                               total=TOTAL // 2, num_keys=NKEYS, name="b",
+                               ts_fn=lambda i: i * 2))
+    m = a.merge(b).split(lambda t: t.v % 2 == 0, 2)
+    (m.select(1).add(wf.Win_Seq(lambda wid, it: it.sum("v"),
+                                WindowSpec(12, 12, win_type_t.CB),
+                                num_keys=NKEYS))
+     .add_sink(wf.Sink(win_sink)))
+    m.select(0).add_sink(wf.Sink(plain_sink))
+    return g
+
+
+def _run_graph(mode=Mode.DEFAULT, supervised=False, **kw):
+    wins, plains = [], []
+    g = _graph(win_collect(wins), collect(plains), mode=mode,
+               **({} if supervised else kw))
+    if supervised:
+        run_graph_supervised(g, checkpoint_every=3, **kw)
+    else:
+        g.run()
+    return wins, plains
+
+
+def test_pipegraph_dispatch_byte_identical_both_modes():
+    for mode in (Mode.DEFAULT, Mode.DETERMINISTIC):
+        w0, p0 = _run_graph(mode)
+        w1, p1 = _run_graph(mode, dispatch=4)
+        assert (w1, p1) == (w0, p0), mode
+
+
+def test_pipegraph_threaded_dispatch_identical():
+    # the threaded graph driver fuses per pipe-thread (ring-dry linger, EOS
+    # tail) — same results as the per-batch threaded run, thread interleave
+    # aside; DETERMINISTIC keeps the Ordering_Node's async readback in play.
+    # In DEFAULT mode the merge interleave is timing-dependent (window
+    # CONTENT varies run to run, dispatch or not), so only interleave-
+    # insensitive aggregates compare; DETERMINISTIC releases in ts order, so
+    # the window multiset is exact.
+    for mode in (Mode.DEFAULT, Mode.DETERMINISTIC):
+        wins, plains = [], []
+        _graph(win_collect(wins), collect(plains), mode=mode).run(
+            threaded=True)
+        w1, p1 = [], []
+        _graph(win_collect(w1), collect(p1), mode=mode,
+               dispatch={"k": 4, "linger_s": 0.0}).run(threaded=True)
+        assert sorted(p1) == sorted(plains), mode
+        if mode == Mode.DETERMINISTIC:
+            assert sorted(w1) == sorted(wins), mode
+        else:
+            assert round(sum(v for _, _, v in w1), 3) == \
+                round(sum(v for _, _, v in wins), 3)
+            assert len(w1) == len(wins)
+
+
+# ------------------------------------------------------ supervised driver
+
+
+def test_supervised_dispatch_byte_identical_mid_accumulator_checkpoint():
+    oracle = []
+    SupervisedPipeline(mk_source(), [wf.Map(lambda t: {"v": t.v * 2})],
+                       wf.Sink(collect(oracle)), batch_size=16).run()
+    # checkpoint_every=5 with K=4: commits land MID-accumulator, forcing the
+    # partial-group flush; with faults, restores clear + replay re-feeds
+    for faults in (None,
+                   FaultPlan([FaultSpec("chain.step", at=[2, 7]),
+                              FaultSpec("checkpoint.save", kind="torn",
+                                        at=[1])])):
+        got = []
+        sp = SupervisedPipeline(mk_source(), [wf.Map(lambda t: {"v": t.v * 2})],
+                                wf.Sink(collect(got)), batch_size=16,
+                                checkpoint_every=5, dispatch=4, faults=faults,
+                                backoff_base=0.001, backoff_cap=0.02)
+        sp.run()
+        assert got == oracle, f"faults={faults is not None}"
+        if faults is not None:
+            assert sp.restarts >= 1
+
+
+def test_supervised_dispatch_windowed_chain_under_faults():
+    oracle = []
+    src = mk_source()
+    op = wf.Win_Seq(lambda wid, it: it.sum("v"),
+                    WindowSpec(10, 10, win_type_t.TB), num_keys=NKEYS)
+    SupervisedPipeline(src, [op], wf.Sink(win_collect(oracle)),
+                       batch_size=16).run()
+    got = []
+    op2 = wf.Win_Seq(lambda wid, it: it.sum("v"),
+                     WindowSpec(10, 10, win_type_t.TB), num_keys=NKEYS)
+    plan = FaultPlan([FaultSpec("chain.step", at=[4]),
+                      FaultSpec("source.next", at=[9])])
+    sp = SupervisedPipeline(mk_source(), [op2], wf.Sink(win_collect(got)),
+                            batch_size=16, checkpoint_every=3, dispatch=4,
+                            faults=plan, backoff_base=0.001, backoff_cap=0.02)
+    sp.run()
+    assert got == oracle
+    assert sp.restarts >= 1
+
+
+def test_supervised_dispatch_poison_quarantines_exact_batch():
+    # a deterministic poison inside a fused group: the group failure is only
+    # attributable to its head, so the replay DEGRADES to per-batch through
+    # the failed range — the failure re-manifests at its true position and
+    # quarantine dead-letters exactly the poison batch, never a group-mate
+    # (and the restart budget is spent like the per-batch path, not once per
+    # innocent head)
+    from windflow_tpu.runtime.faults import DeadLetterQueue
+    oracle = []
+    SupervisedPipeline(mk_source(), [wf.Map(lambda t: {"v": t.v * 2})],
+                       wf.Sink(collect(oracle)), batch_size=16).run()
+    plan = FaultPlan([FaultSpec("chain.step", where={"pos": 4})])
+    got, dlq = [], DeadLetterQueue()
+    sp = SupervisedPipeline(mk_source(), [wf.Map(lambda t: {"v": t.v * 2})],
+                            wf.Sink(collect(got)), batch_size=16,
+                            checkpoint_every=5, dispatch=4, faults=plan,
+                            dead_letter=dlq, poison_threshold=3,
+                            backoff_base=0.001, backoff_cap=0.02)
+    sp.run()
+    assert [e["pos"] for e in dlq.entries] == [4]
+    # every batch except the quarantined one delivered (16 tuples skipped)
+    skipped = {i for i in range(64, 80)}      # batch 4 of 16-tuple batches
+    assert got == [t for t in oracle if t[0] not in skipped]
+
+
+def test_graph_supervised_dispatch_byte_identical_under_faults():
+    # DETERMINISTIC mode: the fused root pushes drive the Ordering_Node's
+    # async counts readback under checkpoint/restore too
+    for mode in (Mode.DEFAULT, Mode.DETERMINISTIC):
+        w0, p0 = _run_graph(mode)
+        plan = FaultPlan([FaultSpec("chain.step", at=[3])])
+        w1, p1 = _run_graph(mode, supervised=True, dispatch=4, faults=plan,
+                            backoff_base=0.001, backoff_cap=0.02)
+        assert (w1, p1) == (w0, p0), mode
+
+
+def test_graph_supervised_dispatch_with_step_timeout():
+    # fused compute AND per-batch delivery both run under the step watchdog
+    # (a generous timeout: nothing fires, results identical)
+    w0, p0 = _run_graph(Mode.DETERMINISTIC)
+    w1, p1 = _run_graph(Mode.DETERMINISTIC, supervised=True, dispatch=4,
+                        step_timeout=30.0)
+    assert (w1, p1) == (w0, p0)
+
+
+# ------------------------------------------------- ordering async readback
+
+
+from test_ordering_renumbering import mk_batch as _mk_ord  # noqa: E402
+
+
+def _mk_ord_batch(ids, ts):
+    return _mk_ord(ids, ts=ts)
+
+
+def test_ordering_async_readback_identical_to_settled():
+    """Deferred counts settle (the async hot path) releases EXACTLY what an
+    eagerly-settled node releases, over a randomized two-channel sweep."""
+    from windflow_tpu.parallel.ordering import Ordering_Node, ordering_mode_t
+
+    def run(eager, seed):
+        rng = np.random.default_rng(seed)
+        node = Ordering_Node(2, ordering_mode_t.TS)
+        out = []
+        t = [0, 0]
+        for _ in range(12):
+            ch = int(rng.integers(0, 2))
+            n = int(rng.integers(1, 5))
+            ts = sorted(int(t[ch] + x) for x in rng.integers(0, 9, n))
+            t[ch] = ts[-1]
+            rel = node.push(ch, _mk_ord_batch(list(range(n)), ts))
+            if eager:
+                node.settle()         # the seed behavior: block every push
+            cnt = node.last_release_count
+            if rel is not None and cnt:
+                v = np.asarray(rel.ts)[:cnt].tolist()
+                out.extend(v)
+        for ch in range(2):
+            rel = node.close_channel(ch)
+            if rel is not None and node.last_release_count:
+                out.extend(np.asarray(rel.ts)[:node.last_release_count]
+                           .tolist())
+        rel = node.flush()
+        if rel is not None and node.last_release_count:
+            out.extend(np.asarray(rel.ts)[:node.last_release_count].tolist())
+        return out
+
+    for seed in range(3):
+        assert run(False, seed) == run(True, seed), seed
+
+
+def test_ordering_push_returns_empty_release_not_stale():
+    from windflow_tpu.parallel.ordering import Ordering_Node, ordering_mode_t
+    node = Ordering_Node(2, ordering_mode_t.TS)
+    rel = node.push(0, _mk_ord_batch([1, 2], [1, 2]))
+    # ch1 silent: nothing releasable — the async contract returns a batch
+    # with zero valid lanes (or None), never stale data
+    assert node.last_release_count == 0
+    if rel is not None:
+        assert int(np.asarray(jnp.sum(rel.valid))) >= 0
+    rel2 = node.push(1, _mk_ord_batch([3], [5]))
+    assert node.last_release_count > 0
+    got = np.asarray(rel2.ts)[:node.last_release_count].tolist()
+    # ch0's ts=1 sits strictly below the low watermark (min(2, 5) = 2);
+    # ts=2 == the watermark is a potential duplicate and stays held
+    assert got == [1]
+
+
+# --------------------------------------------------------- autotuner K
+
+
+def test_dispatch_k_autotuner_ladder_and_cache(tmp_path):
+    cache = str(tmp_path / "tuning.json")
+    cfg = wf.ControlConfig(autotune=True, ladder_up=0, ladder_down=0,
+                           decide_every=2, settle_batches=0,
+                           cache_path=cache)
+    plain = _run_pipeline(None, total=480)
+    got = _run_pipeline({"k": 4, "autotune_k": True}, total=480, control=cfg)
+    assert got == plain
+    assert wfcontrol.gauges()["dispatch_k"] in (1, 2, 4)
+    assert os.path.exists(cache)
+    # the K plan lives under its own namespaced key, beside (never clobbering)
+    # the capacity plan for the same chain
+    assert (wfcontrol.dispatch_tuning_key("sig", "pay", "cpu")
+            != wfcontrol.tuning_key("sig", "pay", "cpu"))
+
+
+def test_dispatch_gauges_registered():
+    from windflow_tpu.observability.names import (CONTROL_GAUGES,
+                                                  JOURNAL_EVENTS,
+                                                  PERF_PROXY_FAMILIES)
+    assert "dispatch_k" in CONTROL_GAUGES
+    assert "dispatch_linger_depth" in CONTROL_GAUGES
+    assert "dispatch_fused" in JOURNAL_EVENTS
+    assert "dispatch" in PERF_PROXY_FAMILIES

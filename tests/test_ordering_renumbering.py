@@ -118,7 +118,9 @@ def test_unbalanced_merge_releases_early_in_push_driver():
 
 def test_ordering_node_channel_eos_unblocks():
     node = Ordering_Node(2, ordering_mode_t.TS)
-    assert node.push(0, mk_batch([1, 2], ts=[1, 2])) is None  # ch1 silent: held
+    held = node.push(0, mk_batch([1, 2], ts=[1, 2]))          # ch1 silent: held
+    assert held is None or not bool(np.asarray(held.valid).any())
+    assert node.last_release_count == 0
     rel = node.close_channel(1)                               # ch1 EOS: stops gating
     got = np.asarray(rel.id)[np.asarray(rel.valid)].tolist()
     # ts=1 < ch0's watermark (2) releases; ts=2 == the watermark is a potential

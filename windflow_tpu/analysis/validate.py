@@ -49,6 +49,16 @@ WF109  warning   kernel impl recorded at trace time disagrees with the
                  traced with, so the toggle the operator thinks is
                  active is NOT what the program runs — the bench would
                  silently measure the same implementation twice
+WF110  warn/err  scan dispatch (K > 1) combined with a configuration
+                 the fused launch cannot honor: an unresolvable
+                 ``dispatch=``/``WF_DISPATCH`` (error);
+                 ``ids="sequence"`` tracing or a wall-clock admission
+                 bucket under supervision (error — the re-formed
+                 groups of a replay would fuse different batches /
+                 mint fresh ids, mirroring WF105/WF108); K exceeding
+                 a ring's capacity (warning, the WF106 shape — a full
+                 fused group can never be ring-resident, so the
+                 consumer always flushes short on the linger)
 ====== ========= =====================================================
 
 Usage::
@@ -416,6 +426,70 @@ def _check_prefetch(report, prefetch: int, first_edge) -> None:
             hint="size prefetch <= the src edge's queue_capacity")
 
 
+def _check_dispatch(report, dispatch, stored_arg, cfg, trace, stored_trace,
+                    supervised: bool, edges=None) -> None:
+    """WF110: scan dispatch (``runtime/dispatch.py``) against configurations
+    the K-fused launch cannot honor — resolved exactly as the driver will
+    (explicit ``dispatch=`` wins, else the object's stored argument /
+    ``WF_DISPATCH``), the WF105/WF108 convention."""
+    from ..runtime.dispatch import DispatchConfig
+    try:
+        dcfg = DispatchConfig.resolve(dispatch if dispatch is not None
+                                      else stored_arg)
+    except (ValueError, TypeError, OSError) as e:
+        report.add("WF110", "error", "dispatch",
+                   f"dispatch config does not resolve: "
+                   f"{type(e).__name__}: {e}",
+                   hint="dispatch= accepts None/bool/int K/dict/"
+                        "DispatchConfig; WF_DISPATCH_K must be a positive "
+                        "integer")
+        return
+    if dcfg is None or dcfg.k <= 1:
+        return
+    if supervised:
+        from ..observability.tracing import TraceConfig
+        try:
+            tcfg = TraceConfig.resolve(trace if trace is not None
+                                       else stored_trace)
+        except (ValueError, TypeError):
+            tcfg = None                # already diagnosed as WF108
+        if tcfg is not None and tcfg.ids != "position":
+            report.add(
+                "WF110", "error", "dispatch",
+                f"dispatch k={dcfg.k} with trace ids={tcfg.ids!r} under "
+                f"supervision: per-batch spans are synthesized from each "
+                f"fused launch, and sequence ids come from a process-global "
+                f"counter — a replay after restore re-forms the groups but "
+                f"mints fresh ids for them, orphaning every exemplar "
+                f"recorded before the failure",
+                hint="use TraceConfig(ids='position') (the default) so span "
+                     "ids are a pure function of stream position, the same "
+                     "contract the accumulator's count-based flush follows")
+        if (cfg is not None and cfg.admission
+                and cfg.refill_per_batch is None):
+            report.add(
+                "WF110", "error", "dispatch",
+                f"dispatch k={dcfg.k} with wall-clock admission (rate_tps) "
+                f"under supervision: group boundaries are count-based so "
+                f"replay re-forms them, but the wall-clock refill timeline "
+                f"shifts on restore — the re-formed groups would fuse "
+                f"DIFFERENT batches than the original run",
+                hint="use ControlConfig(refill_per_batch=...) — positional "
+                     "admission keeps the admitted stream (and therefore "
+                     "every fused group) a pure function of position")
+    for label, cap in (edges or []):
+        if dcfg.k > cap:
+            report.add(
+                "WF110", "warning", f"edge[{label}]",
+                f"dispatch k={dcfg.k} exceeds ring capacity {cap}: a full "
+                f"fused group can never be resident in the ring at once, so "
+                f"the consumer flushes short on the linger nearly every "
+                f"group — the (K, capacity) executable is traced and warmed "
+                f"but rarely runs at full K",
+                hint="size queue_capacity >= dispatch k (room for one full "
+                     "group) or lower k for this topology")
+
+
 def _resolve_control(explicit, stored):
     from ..control import ControlConfig
     if explicit is not None:
@@ -456,7 +530,7 @@ def _validate_chain_ops(report, ops, in_spec, in_cap, where: str,
 
 
 def _validate_pipeline(report, p, faults, control, supervised,
-                       trace=None) -> None:
+                       trace=None, dispatch=None) -> None:
     cfg = _resolve_control(control, getattr(p, "_control", None))
     in_spec = _source_spec(report, p.source, f"source:{p.source.getName()}")
     if in_spec is None:
@@ -469,9 +543,12 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _check_faults(report, faults, "supervised" if supervised else "pipeline")
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(p, "_trace_arg", None), supervised)
+    _check_dispatch(report, dispatch, getattr(p, "_dispatch_arg", None), cfg,
+                    trace, getattr(p, "_trace_arg", None), supervised)
 
 
-def _validate_supervised(report, sp, faults, control, trace=None) -> None:
+def _validate_supervised(report, sp, faults, control, trace=None,
+                         dispatch=None) -> None:
     cfg = _resolve_control(control, getattr(sp, "_control", None))
     in_spec = _source_spec(report, sp.source,
                            f"source:{sp.source.getName()}")
@@ -483,10 +560,12 @@ def _validate_supervised(report, sp, faults, control, trace=None) -> None:
                   else getattr(sp, "_faults_arg", None), "supervised")
     _check_admission(report, cfg, True, "control.admission")
     _check_trace(report, trace, getattr(sp, "_trace_arg", None), True)
+    _check_dispatch(report, dispatch, getattr(sp, "_dispatch_arg", None),
+                    cfg, trace, getattr(sp, "_trace_arg", None), True)
 
 
 def _validate_threaded(report, tp, faults, control, supervised,
-                       trace=None) -> None:
+                       trace=None, dispatch=None) -> None:
     cfg = _resolve_control(control, getattr(tp, "_control", None))
     spec = _source_spec(report, tp.source,
                         f"source:{tp.source.getName()}")
@@ -511,6 +590,9 @@ def _validate_threaded(report, tp, faults, control, supervised,
                   else getattr(tp, "_faults_arg", None), "threaded")
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(tp, "_trace_arg", None), supervised)
+    _check_dispatch(report, dispatch, getattr(tp, "_dispatch_arg", None),
+                    cfg, trace, getattr(tp, "_trace_arg", None), supervised,
+                    edges=edges)
 
 
 def _graph_edges(g):
@@ -541,7 +623,7 @@ def _check_graph_edges(report, g, cfg) -> None:
 
 
 def _validate_graph(report, g, faults, control, supervised,
-                    threaded, trace=None) -> None:
+                    threaded, trace=None, dispatch=None) -> None:
     from ..basic import DEFAULT_BATCH_SIZE
     from ..control import ControlConfig
     from ..runtime.pipeline import resolve_batch_hint
@@ -605,6 +687,15 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_faults(report, faults, driver)
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(g, "_trace_arg", None), supervised)
+    dedges = None
+    if threaded:
+        try:
+            dedges = _graph_edges(g)
+        except Exception:  # noqa: BLE001 — already a WF104 error above
+            dedges = None
+    _check_dispatch(report, dispatch, getattr(g, "_dispatch_arg", None),
+                    cfg, trace, getattr(g, "_trace_arg", None), supervised,
+                    edges=dedges)
 
 
 def _validate_compiled_chain(report, chain, faults, control,
@@ -623,7 +714,8 @@ def _validate_compiled_chain(report, chain, faults, control,
 
 
 def validate(obj, *, faults=None, control=None, supervised: bool = None,
-             threaded: bool = False, trace=None) -> ValidationReport:
+             threaded: bool = False, trace=None,
+             dispatch=None) -> ValidationReport:
     """Validate a built-but-not-run driver object; returns a
     :class:`ValidationReport` (never raises on findings — call
     ``.raise_if_errors()`` to gate).
@@ -645,7 +737,12 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
 
     ``trace``: a ``TraceConfig``/bool/out-dir overriding the object's own
     stored ``trace=`` argument for the WF108 determinism checks; ``None``
-    consults the stored argument and ``WF_TRACE`` (mirroring the drivers)."""
+    consults the stored argument and ``WF_TRACE`` (mirroring the drivers).
+
+    ``dispatch``: a ``DispatchConfig``/bool/int K/dict overriding the
+    object's own stored ``dispatch=`` argument for the WF110 scan-dispatch
+    checks; ``None`` consults the stored argument and ``WF_DISPATCH``
+    (mirroring the drivers)."""
     from ..runtime.pipegraph import PipeGraph
     from ..runtime.pipeline import CompiledChain, Pipeline
     from ..runtime.supervisor import SupervisedPipeline
@@ -654,18 +751,18 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     if isinstance(obj, PipeGraph):
         report = ValidationReport(f"PipeGraph({obj.name!r})")
         _validate_graph(report, obj, faults, control, bool(supervised),
-                        threaded, trace)
+                        threaded, trace, dispatch)
     elif isinstance(obj, SupervisedPipeline):
         report = ValidationReport("SupervisedPipeline")
-        _validate_supervised(report, obj, faults, control, trace)
+        _validate_supervised(report, obj, faults, control, trace, dispatch)
     elif isinstance(obj, ThreadedPipeline):
         report = ValidationReport("ThreadedPipeline")
         _validate_threaded(report, obj, faults, control, bool(supervised),
-                           trace)
+                           trace, dispatch)
     elif isinstance(obj, Pipeline):
         report = ValidationReport("Pipeline")
         _validate_pipeline(report, obj, faults, control, bool(supervised),
-                           trace)
+                           trace, dispatch)
     elif isinstance(obj, CompiledChain):
         report = ValidationReport("CompiledChain")
         _validate_compiled_chain(report, obj, faults, control,

@@ -364,7 +364,13 @@ class MetricsRegistry:
                         "pipe": i,
                         "pending_capacity": (0 if o._pending is None
                                              else int(o._pending.capacity)),
-                        "last_release_count": int(o.last_release_count),
+                        # the RAW settled value (o._last_release_count), not
+                        # the settling property: the reporter thread must
+                        # neither force a device sync on the driver's async
+                        # counts readback nor race its deferred pool trim
+                        # (settle() is driver-thread-only) — telemetry may
+                        # lag the in-flight push by one
+                        "last_release_count": int(o._last_release_count),
                         "mode": o.mode.name,
                     })
         snap = {

@@ -26,8 +26,11 @@ from __future__ import annotations
 JOURNAL_EVENTS = (
     # observability lifecycle (observability/__init__.py Monitor)
     "monitoring_start", "monitoring_end",
-    # compiled-chain hot path (runtime/pipeline.py, sampled)
-    "launch",
+    # compiled-chain hot path (runtime/pipeline.py, sampled): per-batch
+    # "launch", and "dispatch_fused" for a sampled K-batch scan dispatch
+    # (runtime/dispatch.py scan dispatcher; k= says how many batches rode
+    # the one compiled program)
+    "launch", "dispatch_fused",
     # EOS protocol (runtime/pipeline.py, runtime/pipegraph.py)
     "eos", "eos_flush", "eos_propagate",
     # ordering buffer (parallel/ordering.py, via its _journal_release wrapper)
@@ -85,6 +88,10 @@ CONTROL_COUNTERS = (
 #: ``windflow_control_<name>``)
 CONTROL_GAUGES = (
     "chosen_capacity",
+    # scan dispatch (runtime/dispatch.py MicrobatchAccumulator + the
+    # autotuner's K ladder): batches buffered awaiting a fused launch, and
+    # the K rung the dispatch tuner currently runs
+    "dispatch_linger_depth", "dispatch_k",
 )
 
 #: kernel families selectable through the per-backend kernel registry
@@ -101,6 +108,16 @@ KERNELS = (
     "ordering_merge",   # parallel/ordering.py bitonic merge/sort network
     "segment_fold",     # ops/segment.py segment_fold (window fold path)
     "join_probe",       # ops/lookup.py join_probe (stream-table join)
+)
+
+#: non-kernel proxy-microbench families the hermetic perf gate must ALSO
+#: cover (``analysis/perfgate.py::compare``: a family without a proxy row is
+#: a coverage finding, the KERNELS convention). "dispatch" times the scan
+#: dispatcher's fused ``push_many`` launch and carries its jit-boundary
+#: launch counts — the 1-executable-call-per-K-batches amortization claim
+#: ``tests/test_perfgate.py`` asserts.
+PERF_PROXY_FAMILIES = (
+    "dispatch",
 )
 
 #: implementation names a kernel may register under (WF250 checks literal

@@ -41,6 +41,20 @@ so a push never re-sorts the pool. Each push is ONE jitted dispatch that:
 The host reads back exactly ONE tiny transfer per push — the packed
 ``[n_released, n_kept]`` counts, which also feed the backlog trim and (via
 ``last_release_count``) the driver's chunker, so no second sync follows.
+And that one transfer is SYNC-FREE on the push path itself: ``push``/
+``try_release`` start the readback with ``copy_to_host_async`` the moment the
+core is dispatched and return the released batch immediately (possibly with
+zero valid lanes — callers chunk by ``last_release_count``, so an empty
+release flows through untouched). The blocking ``int()`` is deferred until
+the counts are actually consulted — ``last_release_count`` is a property that
+settles the pending transfer and applies the owed backlog trim. The realized
+win is per-push latency, not overlap across pushes (today's callers consult
+the count right after the push): the D2H is enqueued on the device stream
+directly behind the core's compute instead of being REQUESTED by the host
+after it has already blocked — the consult pays the residual compute time
+only, not compute plus a host-initiated synchronous round trip (~65 us RTT
+on the tunneled dev chip, per push). ``flush``/``close_channel``
+(EOS-granular) stay synchronous.
 
 The jitted cores are MODULE-LEVEL functions cached per mode (not per-instance
 ``jax.jit`` wrappers): every Ordering_Node a graph constructs shares one trace
@@ -308,20 +322,64 @@ class Ordering_Node:
         self._pending: Optional[Batch] = None    # INVARIANT: sorted, invalid at tail
         self._pending_chan = None                # i32[C] source channel per lane
         self._next_id = jnp.zeros((), CTRL_DTYPE)   # device scalar (renumbering)
-        #: valid-lane count of the batch last returned by push/try_release/flush
-        #: — already fetched with the release counts, so drivers chunking the
-        #: released batch need no second device sync. Reset to 0 whenever the
-        #: call returns None (no stale value survives a no-release call).
-        self.last_release_count = 0
+        self._last_release_count = 0
+        #: packed [n_released, n_kept] device counts of the last push/
+        #: try_release, D2H already in flight (copy_to_host_async), not yet
+        #: int()ed; settled by ``last_release_count``/``settle`` — which also
+        #: applies the backlog trim those counts size
+        self._counts_pending = None
         self._push_jit, self._first_push_jit, self._release_jit = \
             _jitted_cores(mode, self.merge_impl)
+
+    @property
+    def last_release_count(self) -> int:
+        """Valid-lane count of the batch last returned by push/try_release/
+        flush — fetched with the (async) release counts, so drivers chunking
+        the released batch need no second device sync. Reading it settles any
+        in-flight counts readback; 0 whenever the last call released nothing
+        (no stale value survives a no-release call)."""
+        return self.settle()
+
+    def settle(self) -> int:
+        """Force the deferred counts readback of the last push/try_release
+        (a no-op when none is pending): int() the packed counts, apply the
+        owed backlog trim, record ``last_release_count``. Called implicitly
+        by the next push/try_release/flush and by the property above — the
+        hot path itself never blocks between dispatch and return.
+
+        DRIVER-THREAD ONLY: the check-then-settle is not atomic (the int()
+        blocks on the device and releases the GIL), so a second settling
+        thread could double-apply the pool trim. Off-thread readers
+        (the metrics reporter) read ``_last_release_count`` raw instead."""
+        counts = self._counts_pending
+        if counts is not None:
+            self._counts_pending = None
+            n_out, n_kept = (int(x) for x in np.asarray(counts))
+            self._last_release_count = n_out
+            if self._pending is not None:
+                self._trim_pow2(n_kept)
+        return self._last_release_count
+
+    def _defer_counts(self, counts) -> None:
+        """Start the counts D2H without blocking: the transfer begins the
+        moment the core's compute finishes (not at the eventual ``int()``),
+        so the consult typically finds it already complete."""
+        try:
+            counts.copy_to_host_async()
+        except AttributeError:      # np-backed counts (already host)
+            pass
+        self._counts_pending = counts
 
     # -- host protocol ----------------------------------------------------------------
 
     def push(self, channel: int, batch: Batch) -> Optional[Batch]:
-        """Deliver a batch from ``channel``; returns a released (ordered) batch or
-        None if nothing can be released yet. One jitted dispatch, one packed
-        [n_released, n_kept] readback."""
+        """Deliver a batch from ``channel``; returns the released (ordered)
+        batch — possibly with ZERO valid lanes when nothing can be released
+        yet (``last_release_count`` says which; chunking by it makes the
+        empty case flow through untouched). One jitted dispatch, one packed
+        [n_released, n_kept] readback — started async, settled only when the
+        counts are consulted, so this call never blocks on the device."""
+        self.settle()               # apply the trim owed by the previous call
         ch = jnp.asarray(channel, CTRL_DTYPE)
         if self._pending is None:
             out, kept, mchan, counts, wm, nid = self._first_push_jit(
@@ -333,12 +391,7 @@ class Ordering_Node:
                 self._next_id)
         self._wm_dev, self._next_id = wm, nid
         self._pending, self._pending_chan = kept, mchan
-        n_out, n_kept = (int(x) for x in np.asarray(counts))
-        self._trim_pow2(n_kept)
-        if n_out == 0:
-            self.last_release_count = 0
-            return None
-        self.last_release_count = n_out
+        self._defer_counts(counts)
         return out
 
     def resort_pending(self):
@@ -346,7 +399,11 @@ class Ordering_Node:
         state (supervisor restore: snapshots from the pre-r05 design held the
         pool UNSORTED — the old code re-sorted at every release; the current
         merge/release assume ascending order with invalid lanes at the tail).
-        Eager one-shot sort — a rare recovery path, not the hot path."""
+        Eager one-shot sort — a rare recovery path, not the hot path. Any
+        in-flight counts readback is DISCARDED, not settled: it sized a pool
+        that no longer exists (the restore overwrote it), and applying its
+        trim to the assigned pool would corrupt it."""
+        self._counts_pending = None
         if self._pending is None:
             return
         b, chan = self._pending, self._pending_chan
@@ -403,21 +460,19 @@ class Ordering_Node:
         on channels without a watermark happens inside the jitted release via
         the WM_NONE sentinel). The pool is already sorted — this is one
         elementwise compare, no sort. Exactly ONE host readback: the packed
-        [n_released, n_kept] counts."""
+        [n_released, n_kept] counts — async like :meth:`push`, so the returned
+        batch may have zero valid lanes (``last_release_count`` settles it);
+        None only when there is no pool at all."""
+        self.settle()
         if self._pending is None:
-            self.last_release_count = 0
+            self._last_release_count = 0
             return None
         out, kept, kept_chan, counts, nid = self._release_jit(
             self._pending, self._pending_chan, self._wm_dev, self._next_id,
             False)
         self._pending, self._pending_chan = kept, kept_chan
         self._next_id = nid
-        n_out, n_kept = (int(x) for x in np.asarray(counts))
-        self._trim_pow2(n_kept)
-        if n_out == 0:
-            self.last_release_count = 0
-            return None
-        self.last_release_count = n_out
+        self._defer_counts(counts)
         return out
 
     def _journal_release(self, event: str, **fields) -> None:
@@ -450,9 +505,11 @@ class Ordering_Node:
         return out
 
     def flush(self) -> Optional[Batch]:
-        """EOS: release everything, sorted (the pool already is)."""
+        """EOS: release everything, sorted (the pool already is). Synchronous
+        — EOS-granular, not the hot path."""
+        self.settle()
         if self._pending is None:
-            self.last_release_count = 0
+            self._last_release_count = 0
             self._journal_release("ordering_flush")
             return None
         out, _, _, counts, nid = self._release_jit(
@@ -460,6 +517,6 @@ class Ordering_Node:
             True)
         self._pending, self._pending_chan = None, None
         self._next_id = nid
-        self.last_release_count = int(np.asarray(counts)[0])
+        self._last_release_count = int(np.asarray(counts)[0])
         self._journal_release("ordering_flush")
         return out

@@ -1,0 +1,234 @@
+"""Scan dispatch — device-resident drive loop configuration + accumulator.
+
+The drivers amortize per-tuple overhead by micro-batching, but still pay one
+Python-loop dispatch per BATCH: at the projected YSB headline the host loop,
+not the chip, is the ceiling (the GPU-First argument of arXiv:2306.11686 —
+move the sequential control loop onto the accelerator; the fusion-amortization
+argument of arXiv:1305.1183 applied to *dispatch* instead of kernels). Scan
+dispatch fuses K consecutive batch steps into ONE compiled device program:
+``CompiledChain.push_many`` stacks K same-capacity batches
+(``batch.stack_batches``) and runs ``lax.scan`` over the existing per-op
+``apply`` step with operator states as carry — one trace and one executable
+per (K, capacity), one host dispatch per K batches, byte-identical outputs to
+K sequential ``push`` calls.
+
+Two pieces here, both host-side:
+
+- :class:`DispatchConfig` — the ``dispatch=`` argument resolved (the
+  ``monitoring=``/``control=``/``faults=`` convention: ``None`` consults
+  ``WF_DISPATCH``, off by default; ``WF_DISPATCH_K`` overrides K whenever
+  dispatch is on, like ``WF_TRACE_SAMPLE``).
+- :class:`MicrobatchAccumulator` — gathers up to K same-capacity batches at a
+  driver's ingest boundary. A capacity change flushes the current group first
+  (a scanned executable is traced for one (K, capacity) shape), and a bounded
+  wall-clock *linger* caps how long a partial group may wait in the pull-free
+  drivers (``ThreadedPipeline`` polls ``expired()`` when its input ring runs
+  dry) so latency-sensitive runs are not penalized. The pull drivers
+  (``Pipeline``/``PipeGraph``/supervised) never wait — the source is
+  synchronous, so a partial group only exists at EOS (``drain()``), at a
+  capacity switch, or at a supervised checkpoint boundary (the supervised
+  driver flushes the accumulator before every commit so the snapshot reflects
+  every read position; it ignores ``linger_s`` — wall-clock must not steer
+  the replayed stream).
+
+K = 1 is the degenerate pass-through: every group has one batch and the
+drivers call today's ``push`` path unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import List, Optional, Union
+
+from ..control import _state as _cstate
+
+
+@dataclasses.dataclass
+class DispatchConfig:
+    """Resolved scan-dispatch settings for one driver run."""
+
+    #: batches fused per device program (1 = today's per-batch dispatch)
+    k: int = 8
+    #: max wall-clock seconds a PARTIAL group may linger in a pull-free
+    #: driver before it is dispatched short (0 = dispatch as soon as the
+    #: input ring runs dry). Ignored by the supervised drivers (count-based
+    #: flush only — wall-clock must not steer the replayed stream).
+    linger_s: float = 0.002
+    #: grow the autotuner ladder with a K dimension when the control plane's
+    #: autotune is also on (winner persisted in the same TuningCache)
+    autotune_k: bool = True
+    #: pre-compile the scanned executable for every K rung up front (the
+    #: ``CompiledChain.warm`` discipline) so switches never pay a trace
+    prewarm: bool = True
+
+    def __post_init__(self):
+        if int(self.k) < 1:
+            raise ValueError(f"dispatch k must be >= 1, got {self.k}")
+        if float(self.linger_s) < 0:
+            raise ValueError(
+                f"dispatch linger_s must be >= 0, got {self.linger_s}")
+
+    @classmethod
+    def resolve(cls, dispatch: Union[None, bool, int, str, dict,
+                                     "DispatchConfig"],
+                ) -> Optional["DispatchConfig"]:
+        """Normalize the user-facing ``dispatch=`` argument; None when off.
+        ``None`` consults ``WF_DISPATCH`` (``''``/``'0'`` = off, ``'1'`` =
+        defaults, an integer = K, inline JSON / a JSON file path = field
+        overrides); ``False``/``0`` force off (every off-spelling agrees);
+        ``True`` = defaults; an int = K; a dict = field overrides; a config
+        passes through. ``WF_DISPATCH_K`` overrides ``k`` whenever dispatch
+        is on."""
+        cfg = None
+        if dispatch is False:
+            return None
+        if isinstance(dispatch, DispatchConfig):
+            cfg = dispatch
+        elif isinstance(dispatch, bool):          # True (False returned above)
+            cfg = cls()
+        elif isinstance(dispatch, int):
+            if dispatch == 0:       # the WF_DISPATCH='0' / False spelling
+                return None
+            cfg = cls(k=dispatch)
+        elif isinstance(dispatch, dict):
+            cfg = cls(**dispatch)
+        elif isinstance(dispatch, str):
+            cfg = cls._from_text(dispatch)
+        else:                                     # None: env-driven
+            env = os.environ.get("WF_DISPATCH", "")
+            if env in ("", "0"):
+                return None
+            cfg = cls._from_text(env)
+        k_env = os.environ.get("WF_DISPATCH_K", "")
+        if k_env:
+            cfg = dataclasses.replace(cfg, k=int(k_env))
+        return cfg
+
+    @classmethod
+    def _from_text(cls, text: str) -> "DispatchConfig":
+        text = text.strip()
+        if text in ("1", "true"):
+            return cls()
+        if text.isdigit():
+            return cls(k=int(text))
+        if text and text[0] == "{":
+            return cls(**json.loads(text))
+        with open(text) as f:                 # a path to a JSON config file
+            return cls(**json.load(f))
+
+
+def fused_push(chain, group: List, label: str) -> List:
+    """Run one dispatch group through ``chain`` with per-batch trace spans
+    synthesized from the one launch — THE fused-group execution sequence
+    every non-supervised driver shares (the supervised drivers keep their own
+    variant: spans must open on the driver thread BEFORE the step-watchdog
+    worker runs the push). A singleton group delegates to the per-batch
+    ``push`` executable (the K=1 degenerate — same trace, same sampling
+    path); outputs return in batch order for the caller to deliver."""
+    from ..observability import tracing as _tracing
+    spans = [_tracing.service(b, label) for b in group]
+    outs = (chain.push_many(group) if len(group) > 1
+            else [chain.push(group[0])])
+    for b, out, span in zip(group, outs, spans):
+        if span is not None:
+            span.done()
+            _tracing.carry(b, out)
+    return outs
+
+
+def build_k_ladder(k_max: int) -> List[int]:
+    """Power-of-two K rungs up to (and always including) ``k_max``,
+    ascending with 1 first — the degenerate rung IS today's per-batch push,
+    so the tuner can conclude fusion does not pay on this chain."""
+    k_max = int(k_max)
+    if k_max < 1:
+        raise ValueError(f"k_max must be >= 1, got {k_max}")
+    rungs = {1, k_max}
+    c = 2
+    while c < k_max:
+        rungs.add(c)
+        c *= 2
+    return sorted(rungs)
+
+
+class MicrobatchAccumulator:
+    """Gather up to K same-capacity batches into dispatch groups.
+
+    ``feed`` returns the groups that became ready (zero, one, or — after a
+    capacity change flushed the previous partial group — two). ``expired()``
+    + ``take()`` serve the linger path of polling drivers; ``drain()`` the
+    EOS / checkpoint-boundary tail; ``clear()`` the supervised restore path
+    (replay re-feeds the dropped batches). ``set_k`` actuates an autotuner
+    decision at the next group boundary."""
+
+    def __init__(self, k: int, linger_s: float = 0.0, clock=time.monotonic,
+                 publish_gauge: bool = True):
+        self.k = max(1, int(k))
+        self.linger_s = float(linger_s)
+        self.clock = clock
+        #: whether this accumulator publishes the process-global
+        #: dispatch_linger_depth gauge — the single-driver-thread ingest
+        #: accumulators do; the per-segment/per-pipe accumulators of the
+        #: threaded drivers do NOT (N threads stomping one gauge would report
+        #: a random thread's depth, not anything meaningful)
+        self.publish_gauge = bool(publish_gauge)
+        self._buf: List = []
+        self._t0: Optional[float] = None
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def set_k(self, k: int) -> None:
+        """New group size; takes effect for groups formed from now on (an
+        already-buffered partial group completes at whichever bound it hits
+        first)."""
+        self.k = max(1, int(k))
+
+    def _take(self) -> List:
+        group, self._buf = self._buf, []
+        self._t0 = None
+        if self.publish_gauge:
+            _cstate.set_gauge("dispatch_linger_depth", 0)
+        return group
+
+    def feed(self, batch) -> List[List]:
+        """One batch in; the list of groups now ready to dispatch."""
+        out: List[List] = []
+        if self._buf and self._buf[0].capacity != batch.capacity:
+            # scanned executables are per-(K, capacity): a capacity switch
+            # (rebatcher rung change, EOS-flush odd shapes) dispatches the
+            # buffered run short rather than mixing shapes
+            out.append(self._take())
+        self._buf.append(batch)
+        if self._t0 is None:
+            self._t0 = self.clock()
+        if self.publish_gauge:
+            _cstate.set_gauge("dispatch_linger_depth", len(self._buf))
+        if len(self._buf) >= self.k:
+            out.append(self._take())
+        return out
+
+    def expired(self) -> bool:
+        """True when a partial group has lingered past ``linger_s`` (polling
+        drivers dispatch it short rather than hold latency hostage)."""
+        return (bool(self._buf) and self._t0 is not None
+                and self.clock() - self._t0 >= self.linger_s)
+
+    def take(self) -> List:
+        """Pop the current partial group (linger flush)."""
+        return self._take()
+
+    def drain(self) -> List:
+        """EOS / checkpoint boundary: the partial tail (< K), possibly []."""
+        return self._take() if self._buf else []
+
+    def clear(self) -> None:
+        """Supervised restore: drop buffered batches — replay from the
+        committed position re-feeds them."""
+        self._buf = []
+        self._t0 = None
+        if self.publish_gauge:
+            _cstate.set_gauge("dispatch_linger_depth", 0)

@@ -20,13 +20,16 @@ paths reuse the same compiled shapes (mask padding, never shape change).
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional, Sequence
 
 import jax
 
 from ..basic import DEFAULT_BATCH_SIZE
-from ..batch import Batch
+from ..batch import Batch, stack_batches, unstack_batches
+from ..observability import journal as _journal
 from ..observability import tracing as _tracing
+from . import dispatch as _dispatch
 from ..operators.base import Basic_Operator
 from ..operators.sink import ReduceSink, Sink
 from ..operators.source import SourceBase
@@ -116,6 +119,7 @@ class CompiledChain:
             self.states = [jax.device_put(s, self.device) for s in self.states]
         self._steps = {}
         self._push_count = 0
+        self._fused_count = 0       # push_many launches (scan dispatch)
         self._nbytes_cache = {}     # (from_op, in capacity) -> (in, out bytes)
 
     def warm(self, capacity: int) -> None:
@@ -152,9 +156,104 @@ class CompiledChain:
             self._steps[i] = jax.jit(step)
         return self._steps[i]
 
+    def _scan_fn(self, i: int):
+        """The scan-dispatch core: ONE jitted program running K consecutive
+        batch steps via ``lax.scan`` over the per-op ``apply`` with operator
+        states as carry. The body is the SAME per-batch step ``_step_fn``
+        traces, so a fused launch is byte-identical to K sequential pushes;
+        jax.jit caches one executable per stacked input shape — one trace,
+        one executable per (K, capacity), one host dispatch per K batches."""
+        key = ("scan", i)
+        if key not in self._steps:
+            def scan_step(states, stacked):
+                def body(carry, batch):
+                    carry = list(carry)
+                    for j in range(i, len(self.ops)):
+                        carry[j], batch = self.ops[j].apply(carry[j], batch)
+                    return tuple(carry), batch
+                return jax.lax.scan(body, tuple(states), stacked)
+            self._steps[key] = jax.jit(scan_step)
+        return self._steps[key]
+
+    def warm_scan(self, k: int, capacity: int) -> None:
+        """Trace + compile the K-fused scan executable for ``(k, capacity)``
+        WITHOUT touching operator state (the :meth:`warm` discipline): the
+        dispatch autotuner pre-warms every K rung so a rung switch on the hot
+        path selects a cached executable, never a trace."""
+        if k <= 1:
+            return self.warm(capacity)
+        b = Batch.empty(capacity, self.specs[0])
+        if self.device is not None:
+            b = jax.device_put(b, self.device)
+        stacked = stack_batches([b] * int(k))
+        self._scan_fn(0)(tuple(self.states), stacked)
+
+    def push_many(self, batches: Sequence[Batch],
+                  from_op: int = 0) -> List[Batch]:
+        """Run K same-capacity batches through ops[from_op:] as ONE compiled
+        scan dispatch; updates states; returns the K out batches in order —
+        byte-identical to K sequential :meth:`push` calls. Stats attribute
+        the launch the way one fused program deserves: K batches counted per
+        op, ONE kernel launch on the entry op. K = 1 degenerates to
+        :meth:`push` (same executable, same sampling path)."""
+        batches = list(batches)
+        if len(batches) == 1:
+            return [self.push(batches[0], from_op=from_op)]
+        k = len(batches)
+        stacked = stack_batches(batches)
+        if self.device is not None:
+            stacked = jax.device_put(stacked, self.device)
+        # per-LAUNCH sampling (the push-path predicate over launch count):
+        # every Nth fused dispatch is timed to completion, the other N-1 keep
+        # the async queue full
+        self._fused_count += 1
+        c = self._fused_count
+        sampled = ((c % self.SERVICE_SAMPLE_EVERY) == 0
+                   or (1 < c < self.SERVICE_SAMPLE_EVERY
+                       and (c & (c - 1)) == 0))
+        t0 = time.perf_counter() if sampled else 0.0
+        states, outs_stacked = self._scan_fn(from_op)(tuple(self.states),
+                                                      stacked)
+        if sampled:
+            jax.block_until_ready(outs_stacked)
+            service_s = time.perf_counter() - t0
+            if _journal.get_active() is not None:
+                _journal.record(
+                    "dispatch_fused",
+                    op=self.ops[from_op].getName() if self.ops else "",
+                    from_op=from_op, k=k, launch=c,
+                    service_s=round(service_s, 6))
+        else:
+            service_s = None
+        self.states = list(states)
+        self._push_count += k
+        outs = unstack_batches(outs_stacked, k)
+        # batch/byte counters mirror push: K batches per op, static shapes
+        ck = (from_op, batches[0].capacity)
+        if ck in self._nbytes_cache:
+            in_bytes, out_bytes = self._nbytes_cache[ck]
+        else:
+            in_bytes, out_bytes = (_batch_nbytes(batches[0]),
+                                   _batch_nbytes(outs[0]))
+            self._nbytes_cache[ck] = (in_bytes, out_bytes)
+        for j in range(from_op, len(self.ops)):
+            rec = self.ops[j].get_StatsRecords()[0]
+            rec.batches_received += k
+            rec.batches_sent += k
+            rec.bytes_received += k * in_bytes
+            rec.bytes_sent += k * out_bytes
+        if self.ops:
+            # ONE launch for K batches — the dispatch-amortization claim the
+            # perf gate asserts (num_kernels vs batches_received)
+            tid = next((t for t in map(_tracing.tid_of, batches)
+                        if t is not None), None)
+            self.ops[from_op].get_StatsRecords()[0].record_launch(
+                service_s,
+                exemplar=None if service_s is None else tid)
+        return outs
+
     def push(self, batch: Batch, from_op: int = 0) -> Batch:
         """Run one batch through ops[from_op:]; updates states; returns the out batch."""
-        import time
         if self.device is not None:
             batch = jax.device_put(batch, self.device)
         self._push_count += 1
@@ -174,7 +273,6 @@ class CompiledChain:
             service_s = time.perf_counter() - t0
             # sampled compiled-program launch -> the event journal (no-op —
             # one None check — unless monitoring activated a journal)
-            from ..observability import journal as _journal
             if _journal.get_active() is not None:
                 _journal.record(
                     "launch", op=self.ops[from_op].getName() if self.ops else "",
@@ -252,7 +350,7 @@ class Pipeline:
     def __init__(self, source: SourceBase, ops: Sequence[Basic_Operator],
                  sink: Optional[Sink] = None, *,
                  batch_size: Optional[int] = None, prefetch: int = 0,
-                 monitoring=None, control=None, trace=None):
+                 monitoring=None, control=None, trace=None, dispatch=None):
         self.source = source
         self.sink = sink
         if batch_size is None:
@@ -291,6 +389,10 @@ class Pipeline:
         #: observability.tracing.TraceConfig.resolve) — same lazy resolution
         self._trace_arg = trace
         self._tracer = None
+        #: scan dispatch (None = consult WF_DISPATCH; see
+        #: runtime.dispatch.DispatchConfig.resolve) — off by default: with
+        #: dispatch off the drive loop runs today's exact per-batch path
+        self._dispatch_arg = dispatch
 
     def _make_controller(self):
         """Assemble the run-scoped control pieces from the resolved config:
@@ -334,6 +436,52 @@ class Pipeline:
         admission = admission_from_config(cfg, base, driver="pipeline")
         return tuner, rebatcher, admission
 
+    def _make_dispatcher(self):
+        """Resolve ``dispatch=``/``WF_DISPATCH`` into (accumulator, K-tuner)
+        — both None when scan dispatch is off. The K tuner is the SAME
+        hill-climber class the capacity ladder uses, pointed at a power-of-two
+        K ladder (1 included — the degenerate rung IS per-batch push), its
+        winner persisted in the shared TuningCache under a dispatch key."""
+        from .dispatch import DispatchConfig, MicrobatchAccumulator, \
+            build_k_ladder
+        dcfg = DispatchConfig.resolve(self._dispatch_arg)
+        if dcfg is None:
+            return None, None
+        acc = MicrobatchAccumulator(dcfg.k, dcfg.linger_s)
+        ktuner = None
+        cfg = self._control
+        base = getattr(self.source, "out_capacity",
+                       lambda b: b)(self.batch_size)
+        if (dcfg.autotune_k and cfg is not None and cfg.autotune
+                and dcfg.k > 1):
+            from ..control import (CapacityAutotuner, TuningCache,
+                                   chain_signature, device_kind,
+                                   dispatch_tuning_key, payload_signature)
+            ladder = build_k_ladder(dcfg.k)
+            cache = key = None
+            if cfg.cache_path:
+                cache = TuningCache(cfg.cache_path)
+                key = dispatch_tuning_key(
+                    chain_signature(self.chain.ops),
+                    payload_signature(self.chain.specs[0]), device_kind())
+            ktuner = CapacityAutotuner(
+                ladder, start_capacity=dcfg.k,
+                decide_every=cfg.decide_every,
+                settle_batches=cfg.settle_batches,
+                improve_threshold=cfg.improve_threshold,
+                cache=cache, cache_key=key,
+                name=self.source.getName() + "-dispatch-k",
+                gauge="dispatch_k")
+            acc.set_k(ktuner.capacity)
+            if dcfg.prewarm:
+                warm_ks = ({ktuner.capacity, 1} if ktuner.converged
+                           else ladder)
+                for kr in sorted(warm_ks):
+                    self.chain.warm_scan(kr, base)
+        elif dcfg.prewarm and dcfg.k > 1:
+            self.chain.warm_scan(dcfg.k, base)
+        return acc, ktuner
+
     def run(self):
         import time as _time
         from ..observability import Monitor, MonitoringConfig, TraceConfig, \
@@ -349,9 +497,12 @@ class Pipeline:
             self._tracer = Tracer(tcfg,
                                   self.source.getName() + "-pipeline").start()
         tuner, rebatcher, admission = self._make_controller()
+        acc, ktuner = self._make_dispatcher()
         if mon is not None and tuner is not None:
             mon.registry.attach_gauge("control_chosen_capacity",
                                       lambda: tuner.capacity)
+        if mon is not None and acc is not None:
+            mon.registry.attach_gauge("dispatch_k", lambda: acc.k)
         try:
             batches = (self.source.batches_prefetched(
                            self.batch_size, self.prefetch,
@@ -391,6 +542,51 @@ class Pipeline:
                     newcap = tuner.on_batch(b.capacity)
                     if newcap is not None:
                         rebatcher.set_target(newcap)
+                if ktuner is not None:
+                    newk = ktuner.on_batch(b.capacity)
+                    if newk is not None:
+                        acc.set_k(newk)
+
+            def drive_many(group):
+                # K batches, ONE compiled scan dispatch: per-batch sink
+                # delivery, trace spans, e2e samples, and tuner accounting
+                # are synthesized from the one launch, in batch order
+                nonlocal n
+                if len(group) == 1:
+                    drive(group[0])
+                    return
+                sampled_any = (mon is not None and self.sink is not None
+                               and any(mon.config.should_sample_e2e(n + i)
+                                       for i in range(len(group))))
+                t0 = _time.perf_counter() if sampled_any else 0.0
+                outs = _dispatch.fused_push(self.chain, group, "chain")
+                for b, out in zip(group, outs):
+                    if self.sink is not None:
+                        sspan = _tracing.service(out, "sink")
+                        self.sink.consume(out)
+                        if sspan is not None:
+                            sspan.done()
+                    if (mon is not None and self.sink is not None
+                            and mon.config.should_sample_e2e(n)):
+                        mon.registry.record_e2e(_time.perf_counter() - t0,
+                                                exemplar=_tracing.tid_of(b))
+                    n += 1
+                    if tuner is not None:
+                        newcap = tuner.on_batch(b.capacity)
+                        if newcap is not None:
+                            rebatcher.set_target(newcap)
+                    if ktuner is not None:
+                        newk = ktuner.on_batch(b.capacity)
+                        if newk is not None:
+                            acc.set_k(newk)
+
+            def feed(rb):
+                # with dispatch off this IS drive(rb) — today's exact path
+                if acc is None:
+                    drive(rb)
+                else:
+                    for g in acc.feed(rb):
+                        drive_many(g)
 
             n_offered = 0
             for batch in batches:
@@ -405,17 +601,20 @@ class Pipeline:
                 for ab in admitted:
                     for rb in (rebatcher.feed(ab) if rebatcher is not None
                                else (ab,)):
-                        drive(rb)
-            from ..observability import journal as _journal
+                        feed(rb)
             _journal.record("eos", pipeline=self.source.getName())
             if admission is not None:
                 for ab in admission.drain():      # bounded held tail
                     for rb in (rebatcher.feed(ab) if rebatcher is not None
                                else (ab,)):
-                        drive(rb)
+                        feed(rb)
             if rebatcher is not None:
                 for rb in rebatcher.drain():      # partial up-rung buffer
-                    drive(rb)
+                    feed(rb)
+            if acc is not None:
+                tail = acc.drain()                # partial tail < K at EOS
+                if tail:
+                    drive_many(tail)
             for out in self.chain.flush():
                 if self.sink is not None:
                     self.sink.consume(out)
