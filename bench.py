@@ -318,6 +318,35 @@ def bench_ysb_wmr(map_parallelism: int = 4):
     return STEPS * BATCH / dt, dt / STEPS, roof, _chain_metrics(chain, dt / STEPS)
 
 
+def bench_nexmark(batch: int = None, steps: int = None):
+    """The Nexmark-class query suite (``windflow_tpu/nexmark``): tuples/s
+    per query over the names.py::NEXMARK_QUERIES registry, each chain
+    compiled + driven with the same device-cursor step discipline as
+    bench_ysb. Smaller default batch than the headline: the join/session
+    state machinery is [C, A]-quadratic in places, and the suite's job is
+    the per-query TREND (bench_trend.py renders the rows beside YSB), not
+    a memory-bandwidth headline. ``WF_BENCH_NEXMARK_EVENTS`` overrides the
+    per-query event budget."""
+    import jax
+    from windflow_tpu.benchmarks import device_cursor_step
+    from windflow_tpu.nexmark import QUERIES, make_query
+    from windflow_tpu.runtime.pipeline import CompiledChain
+
+    batch = int(batch or min(BATCH, 1 << 14))
+    steps = int(steps or min(STEPS, 20))
+    budget = os.environ.get("WF_BENCH_NEXMARK_EVENTS", "")
+    total = int(budget) if budget else (steps + 2) * batch
+    rows = {}
+    for name in QUERIES:
+        src, ops = make_query(name, total)
+        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
+        step = device_cursor_step(chain, src, batch)
+        dt, _ = _bench_loop(step, tuple(chain.states), steps)
+        rows[name] = {"tps": steps * batch / dt, "step_s": dt / steps,
+                      "batch": batch}
+    return rows
+
+
 def bench_stateless():
     """Config 2 of BASELINE.json: Source->Map->Filter->Sink micro-batch."""
     import jax
@@ -1145,6 +1174,15 @@ def _secondary_benches(ysb_tps, ysb_step_s, headline=None):
           f"({dd['fused']['launches_per_batch']:.3f} launches/batch) vs "
           f"{dd['per_batch']['tps']/1e6:.2f} M per-batch "
           f"({dd['speedup']:.2f}x)", file=sys.stderr)
+    nx = _run_isolated("bench_nexmark()")
+    record("nexmark", nx, methodology="isolated-subprocess")
+    if headline is not None:
+        headline["nexmark"] = {q: round(r["tps"], 1) for q, r in nx.items()}
+        record_headline(headline)
+    for q, r in sorted(nx.items()):
+        print(f"nexmark {q}: {r['tps']/1e6:.2f} M tuples/s "
+              f"({r['step_s']*1e3:.2f} ms/step, batch={r['batch']})",
+              file=sys.stderr)
     kc_tps, kc_step, kc_roof, kc_metrics = _run_isolated("bench_keyed_cb()")
     record("keyed_cb", {"tps": kc_tps, "step_s": kc_step, "roofline": kc_roof,
                         "metrics": kc_metrics},

@@ -119,6 +119,28 @@ def bench_rows(rounds, threshold: float):
     return rows
 
 
+def nexmark_rows(rounds):
+    """Per-round Nexmark query throughput (the bench.py headline ``nexmark``
+    record: ``{query: tps}``). Rounds predating the suite render as '—';
+    failed rounds surface the same way the main table does."""
+    queries, rows = [], []
+    for n, d in rounds:
+        nx = (d.get("parsed") or {}).get("nexmark")
+        if isinstance(nx, dict):
+            for q in nx:
+                if q not in queries:
+                    queries.append(q)
+    for n, d in rounds:
+        parsed = d.get("parsed")
+        nx = (parsed or {}).get("nexmark")
+        row = {"round": n, "tps": nx if isinstance(nx, dict) else None,
+               "status": "ok" if isinstance(nx, dict) else
+               ("FAILED" if parsed is None or d.get("rc") not in (0, None)
+                else "—")}
+        rows.append(row)
+    return sorted(queries), rows
+
+
 def multichip_rows(rounds):
     rows = []
     for n, d in rounds:
@@ -151,7 +173,28 @@ def _fmt(v):
     return str(v)
 
 
-def render_markdown(bench, multichip, threshold: float) -> str:
+def render_nexmark(queries, rows) -> list:
+    """The Nexmark query table beside YSB — one column per query, M t/s."""
+    lines = ["", "## Nexmark queries (`parsed.nexmark`, M tuples/s)", ""]
+    if not queries:
+        lines += ["(no round carries a nexmark record yet — the suite "
+                  "lands in the next capture)"]
+        return lines
+    lines.append("| round | status | " + " | ".join(queries) + " |")
+    lines.append("|---|---|" + "---|" * len(queries))
+    for r in rows:
+        cells = []
+        for q in queries:
+            v = (r["tps"] or {}).get(q)
+            cells.append(f"{v / 1e6:.2f}" if isinstance(v, (int, float))
+                         else "—")
+        lines.append(f"| r{r['round']:02d} | {r['status']} | "
+                     + " | ".join(cells) + " |")
+    return lines
+
+
+def render_markdown(bench, multichip, threshold: float,
+                    nexmark=None) -> str:
     lines = ["# Bench trend", ""]
     lines.append(f"Regression flag: value < (1 - {threshold:g}) x "
                  f"best-so-far among fresh (non-stale) measured rounds.")
@@ -175,6 +218,8 @@ def render_markdown(bench, multichip, threshold: float) -> str:
     if not bench:
         lines.append("| — | — | — | — | — | — | — | — "
                      "| no BENCH_r*.json found |")
+    if nexmark is not None:
+        lines += render_nexmark(*nexmark)
     lines.append("")
     lines.append("## Multi-chip smoke (`MULTICHIP_r*.json`)")
     lines.append("")
@@ -223,7 +268,8 @@ def main(argv=None) -> int:
         return 2
     brows = bench_rows(bench, args.threshold)
     mrows = multichip_rows(multichip)
-    md = render_markdown(brows, mrows, args.threshold)
+    md = render_markdown(brows, mrows, args.threshold,
+                         nexmark=nexmark_rows(bench))
     if args.out:
         with open(args.out, "w") as f:
             f.write(md)

@@ -1,0 +1,405 @@
+"""Unit coverage for the join/session/rank operator family: the versioned
+JoinTable (last-writer-wins determinism, as-of-watermark reads, rollback
+guard, overflow accounting), the registry-resolved probe path (oversize
+tables route to the XLA reference instead of raising; Pallas-interpret
+parity), the session triggerer, top-N eviction accounting, distinct
+semantics, and the WF111/WF112 pre-run diagnostics."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.analysis import validate
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.operators.join import IntervalJoin, StreamTableJoin
+from windflow_tpu.operators.rank import TOPN_SENTINEL, Distinct, TopN
+from windflow_tpu.operators.session import SessionWindow
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.ops.lookup import (JOIN_PROBE_MAX_ROWS, _join_probe_xla,
+                                     join_probe, join_table_init,
+                                     join_table_pending, join_table_probe,
+                                     join_table_upsert)
+
+I32 = jnp.int32
+SPEC = {"v": jax.ShapeDtypeStruct((), I32)}
+
+
+def up1(st, key, val, ts, tid, *, delay=0):
+    return join_table_upsert(
+        st, jnp.asarray([key], I32), {"v": jnp.asarray([val], I32)},
+        jnp.asarray([ts], I32), jnp.asarray([tid], I32),
+        jnp.ones(1, bool), delay=delay)
+
+
+# ----------------------------------------------------------- JoinTable core
+
+def test_join_table_duplicate_keys_last_writer_wins_by_event_time():
+    st = join_table_init(8, 16, SPEC)
+    st = join_table_upsert(
+        st, jnp.asarray([1, 2, 1, 3], I32),
+        {"v": jnp.asarray([10, 20, 11, 30], I32)},
+        jnp.asarray([5, 5, 7, 5], I32), jnp.asarray([0, 1, 2, 3], I32),
+        jnp.ones(4, bool))
+    vals, hit = join_table_probe(st, jnp.asarray([1, 2, 3, 9], I32),
+                                 jnp.ones(4, bool))
+    assert np.asarray(hit).tolist() == [True, True, True, False]
+    # key 1 took the ts=7 version, not the scatter-luck one
+    assert np.asarray(vals["v"]).tolist() == [11, 20, 30, 0]
+    assert int(np.asarray(st["version"])) == 3
+
+
+def test_join_table_same_ts_ties_break_by_id():
+    st = join_table_init(4, 8, SPEC)
+    st = join_table_upsert(
+        st, jnp.asarray([7, 7], I32), {"v": jnp.asarray([100, 200], I32)},
+        jnp.asarray([3, 3], I32), jnp.asarray([9, 4], I32),
+        jnp.ones(2, bool))
+    vals, _ = join_table_probe(st, jnp.asarray([7], I32), jnp.ones(1, bool))
+    assert int(np.asarray(vals["v"])[0]) == 100        # id 9 > id 4
+
+
+def test_join_table_watermark_delay_gates_visibility():
+    st = join_table_init(8, 16, SPEC)
+    st = up1(st, 5, 99, 10, 0, delay=3)
+    _, hit = join_table_probe(st, jnp.asarray([5], I32), jnp.ones(1, bool))
+    assert not bool(np.asarray(hit)[0])
+    assert int(np.asarray(join_table_pending(st))) == 1
+    # watermark reaches ts + delay: the version becomes visible
+    st = up1(st, 0, 0, 13, 1, delay=3)
+    vals, hit = join_table_probe(st, jnp.asarray([5], I32),
+                                 jnp.ones(1, bool))
+    assert bool(np.asarray(hit)[0])
+    assert int(np.asarray(vals["v"])[0]) == 99
+    # the ts=13 upsert itself now parks behind the watermark
+    assert int(np.asarray(join_table_pending(st))) == 1
+
+
+def test_join_table_late_eligible_upsert_cannot_roll_back():
+    st = join_table_init(8, 16, SPEC)
+    st = up1(st, 4, 100, 10, 0)
+    st = up1(st, 4, 50, 8, 1)          # older event time, arrives later
+    vals, _ = join_table_probe(st, jnp.asarray([4], I32), jnp.ones(1, bool))
+    assert int(np.asarray(vals["v"])[0]) == 100
+
+
+def test_join_table_overflow_drops_are_counted():
+    st = join_table_init(2, 2, SPEC)   # tiny table AND tiny ring
+    st = join_table_upsert(
+        st, jnp.asarray([1, 2, 3], I32),
+        {"v": jnp.asarray([1, 2, 3], I32)},
+        jnp.asarray([1, 1, 1], I32), jnp.asarray([0, 1, 2], I32),
+        jnp.ones(3, bool))
+    # ring capacity 2: third upsert dropped; table capacity 2 holds the rest
+    assert int(np.asarray(st["dropped"])) >= 1
+    _, hit = join_table_probe(st, jnp.asarray([1, 2], I32),
+                              jnp.ones(2, bool))
+    assert np.asarray(hit).tolist() == [True, True]
+
+
+def test_join_table_state_is_checkpointable_pytree():
+    st = join_table_init(4, 8, SPEC)
+    st = up1(st, 1, 5, 2, 0)
+    host = jax.tree.map(np.asarray, st)          # the supervisor snapshot
+    back = jax.tree.map(jnp.asarray, host)
+    vals, hit = join_table_probe(back, jnp.asarray([1], I32),
+                                 jnp.ones(1, bool))
+    assert bool(np.asarray(hit)[0]) and int(np.asarray(vals["v"])[0]) == 5
+
+
+# -------------------------------------------------- registry probe contract
+
+def test_join_probe_oversize_routes_to_xla_reference_not_raise():
+    K = 2 * JOIN_PROBE_MAX_ROWS                  # beyond the Pallas envelope
+    tk = jnp.arange(K, dtype=I32)
+    tv = tk * 3
+    probe = jnp.pad(jnp.asarray([5, K - 7, 123], I32), (0, 125))
+    ok = jnp.arange(128) < 3
+    got = join_probe(tk, tv, probe, ok, impl="pallas")
+    ref = _join_probe_xla(tk, tv, probe, ok)
+    assert np.array_equal(np.asarray(got[0]), np.asarray(ref[0]))
+    assert np.array_equal(np.asarray(got[1]), np.asarray(ref[1]))
+
+
+def test_join_table_probe_pallas_interpret_parity():
+    st = join_table_init(512, 512, SPEC)
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.permutation(4096)[:256].astype(np.int32))
+    st = join_table_upsert(
+        st, keys, {"v": keys * 7}, jnp.zeros(256, I32),
+        jnp.arange(256, dtype=I32), jnp.ones(256, bool))
+    probe = jnp.asarray(rng.integers(0, 4096, 128).astype(np.int32))
+    ok = jnp.ones(128, bool)
+    vx, hx = join_table_probe(st, probe, ok, impl="xla")
+    vp, hp = join_table_probe(st, probe, ok, impl="pallas")
+    assert np.array_equal(np.asarray(vx["v"]), np.asarray(vp["v"]))
+    assert np.array_equal(np.asarray(hx), np.asarray(hp))
+
+
+def test_join_table_probe_multi_column_single_contraction_parity():
+    """Multi-column values probe the slot ONCE and gather each column —
+    byte-identical to per-column probing."""
+    spec2 = {"a": jax.ShapeDtypeStruct((), I32),
+             "b": jax.ShapeDtypeStruct((), jnp.float32)}
+    st = join_table_init(16, 16, spec2)
+    keys = jnp.asarray([3, 9, 12], I32)
+    st = join_table_upsert(
+        st, keys, {"a": keys * 2, "b": keys.astype(jnp.float32) * 0.5},
+        jnp.zeros(3, I32), jnp.arange(3, dtype=I32), jnp.ones(3, bool))
+    probe = jnp.asarray([9, 4, 12, 3], I32)
+    ok = jnp.ones(4, bool)
+    vals, hit = join_table_probe(st, probe, ok)
+    assert np.asarray(hit).tolist() == [True, False, True, True]
+    assert np.asarray(vals["a"]).tolist() == [18, 0, 24, 6]
+    assert np.asarray(vals["b"]).tolist() == [4.5, 0.0, 6.0, 1.5]
+
+
+def test_interval_join_ts_extractors_batching_invariant():
+    """With ts_l/ts_r payload extractors, the probing side's emit() ref
+    carries the EXTRACTED event time — the emitted multiset (including the
+    ts fields emit() reads) is identical whichever member arrived later."""
+    def gen(i):
+        is_l = (i % 8) == 0
+        return {"side": jnp.where(is_l, 1, 0).astype(I32),
+                "ev": (i // 4).astype(I32),
+                "p": (i * 3).astype(I32)}
+    def run(batch):
+        src = wf.Source(gen, total=64, num_keys=1, key_fn=lambda i: i * 0,
+                        ts_fn=lambda i: i // 4)
+        op = IntervalJoin(lambda t: t.side == 1, 0, 2, max_matches=16,
+                          ts_l=lambda t: t.ev, ts_r=lambda t: t.ev,
+                          emit=lambda l, r: {"lt": l.ts, "rt": r.ts,
+                                             "p": r.data["p"]})
+        rows = []
+
+        def cb(view):
+            if view is None:
+                return
+            rows.extend(zip(np.asarray(view["payload"]["lt"]).tolist(),
+                            np.asarray(view["payload"]["rt"]).tolist(),
+                            np.asarray(view["payload"]["p"]).tolist()))
+        wf.Pipeline(src, [op], wf.Sink(cb), batch_size=batch).run()
+        return sorted(rows)
+    a, b, c = run(8), run(16), run(64)
+    assert a == b == c and a
+    # every emitted lt/rt is an extracted event time (i // 4 domain)
+    assert all(0 <= lt <= 16 and 0 <= rt <= 16 for lt, rt, _ in a)
+
+
+# ------------------------------------------------------- operator semantics
+
+def _tagged_source(total, defs):
+    """side=1 definition events for the first ``defs`` indexes, bids after."""
+    def gen(i):
+        is_def = i < defs
+        return {"side": jnp.where(is_def, 1, 0).astype(I32),
+                "k": jnp.where(is_def, i % 4, (i * 3) % 4).astype(I32),
+                "val": (i * 10).astype(I32)}
+    return wf.Source(gen, total=total, num_keys=4,
+                     key_fn=lambda i: jnp.where(i < defs, i % 4, (i * 3) % 4),
+                     ts_fn=lambda i: i // 2)
+
+
+def test_stream_table_join_left_join_emits_misses():
+    src = _tagged_source(20, 2)        # only keys 0, 1 defined
+    rows = []
+
+    def cb(view):
+        if view is None:
+            return
+        rows.extend(zip(view["id"].tolist(),
+                        np.asarray(view["payload"]["k"]).tolist(),
+                        np.asarray(view["payload"]["val"]).tolist()))
+    op = StreamTableJoin(lambda t: t.side == 1, lambda t: t.k,
+                         lambda t: {"jv": t.val}, num_slots=8,
+                         emit_misses=True)
+    wf.Pipeline(src, [op], wf.Sink(cb), batch_size=8).run()
+    assert len(rows) == 18             # every probe lane, hit or miss
+
+
+def test_interval_join_match_drops_counted_when_max_matches_too_small():
+    def gen(i):
+        return {"side": jnp.where(i == 0, 1, 0).astype(I32),
+                "p": (i * 1).astype(I32)}
+    src = wf.Source(gen, total=8, num_keys=1, key_fn=lambda i: i * 0,
+                    ts_fn=lambda i: i * 0)       # everything at ts 0
+    op = IntervalJoin(lambda t: t.side == 1, 0, 0, max_matches=2)
+    chain = wf.CompiledChain([op], src.payload_spec(), batch_capacity=8)
+    b = next(src.batches(8))
+    chain.push(b)
+    # the single open matches 7 same-tick bids; 2 kept, 5 counted dropped
+    assert int(np.asarray(chain.states[0]["match_drops"])) == 5
+
+
+def test_topn_eviction_counter_and_tie_break():
+    src = wf.Source(lambda i: {"s": ((i * 7) % 50).astype(I32)},
+                    total=40, num_keys=2, ts_fn=lambda i: i)
+    op = TopN(lambda t: t.s, 2, num_keys=2)
+    rows = {}
+
+    def cb(view):
+        if view is None:
+            return
+        for k, r, i, s in zip(view["key"].tolist(),
+                              np.asarray(view["payload"]["rank"]).tolist(),
+                              view["id"].tolist(),
+                              np.asarray(view["payload"]["score"]).tolist()):
+            rows[(k, r)] = (i, s)
+    wf.Pipeline(src, [op], wf.Sink(cb), batch_size=10).run()
+    want = {}
+    per = {}
+    for i in range(40):
+        per.setdefault(i % 2, []).append((-((i * 7) % 50), i))
+    for k, cands in per.items():
+        for r, (ns, i) in enumerate(sorted(cands)[:2]):
+            want[(k, r)] = (i, -ns)
+    assert rows == want
+    from windflow_tpu.control import _state as _cstate
+    assert _cstate.counters().get("topn_evictions", 0) > 0
+
+
+def test_topn_rejects_sentinel_score_domain():
+    assert TOPN_SENTINEL == -(1 << 31) + 1       # documented domain floor
+
+
+def test_distinct_in_batch_and_cross_batch_dedup():
+    src = wf.Source(lambda i: {"d": (i % 3).astype(I32)}, total=30,
+                    num_keys=1, ts_fn=lambda i: i)
+    rows = []
+
+    def cb(view):
+        if view is None:
+            return
+        rows.extend(zip(view["id"].tolist(),
+                        np.asarray(view["payload"]["d"]).tolist()))
+    wf.Pipeline(src, [Distinct(lambda t: t.d, num_slots=8)],
+                wf.Sink(cb), batch_size=7).run()
+    assert sorted(rows) == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_session_old_events_dropped_and_counted():
+    # key 0: ts 0,1 then a gap to ts 10,11 (first session closes on
+    # in-batch evidence, floor=1) — then a straggler at ts 2 arrives in the
+    # NEXT batch, inside the closed session's span: OLD, dropped, counted
+    ts_tab = jnp.asarray([0, 1, 10, 11, 2, 12, 13, 14], I32)
+    src = wf.Source(lambda i: {"v": jnp.ones((), I32)}, total=8,
+                    num_keys=1, ts_fn=lambda i: ts_tab[i])
+    op = SessionWindow(lambda t: t.v, WindowSpec.session(3), num_keys=1)
+    rows = []
+
+    def cb(view):
+        if view is None:
+            return
+        rows.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                        np.asarray(view["payload"]["start"]).tolist(),
+                        np.asarray(view["payload"]["end"]).tolist(),
+                        np.asarray(view["payload"]["n"]).tolist()))
+    wf.Pipeline(src, [op], wf.Sink(cb), batch_size=4).run()
+    assert (0, 0, 0, 1, 2) in rows               # first session closed
+    assert (0, 1, 10, 14, 5) in rows             # second session at EOS
+    op.collect_stats(None)
+    assert op.get_StatsRecords()[0].tuples_dropped_old == 1
+
+
+def test_session_spec_requires_session_type():
+    with pytest.raises(ValueError, match="session spec"):
+        SessionWindow(lambda t: t.v, WindowSpec(10, 10, win_type_t.TB))
+
+
+def test_windowspec_session_triggerer_is_gap_dependent():
+    spec = WindowSpec.session(5, delay=2)
+    assert spec.is_session and spec.gap == 5
+    last = jnp.asarray([0, 10], I32)
+    fired = spec.fired_session(last, jnp.asarray(8, I32))
+    # wm 8, delay 2: horizon 6 — session ending at 0 fired (0+5 < 6),
+    # session ending at 10 not
+    assert np.asarray(fired).tolist() == [True, False]
+
+
+# --------------------------------------------------------- WF111 / WF112
+
+def _pipe(ops, ts_fn="yes"):
+    src = wf.Source(lambda i: {"side": (i % 2).astype(I32),
+                               "v": (i * 1).astype(I32)},
+                    total=64, num_keys=4,
+                    ts_fn=(lambda i: i // 4) if ts_fn else None)
+    return wf.Pipeline(src, ops, wf.Sink(lambda v: None), batch_size=32)
+
+
+def test_wf111_empty_match_window():
+    rep = validate(_pipe([IntervalJoin(lambda t: t.side == 1, 5, 2)]))
+    assert any(d.code == "WF111" and "empty" in d.message
+               for d in rep.errors)
+    assert rep.errors[0].hint
+
+
+def test_wf111_bounds_incompatible_with_watermark_delay():
+    rep = validate(_pipe([IntervalJoin(lambda t: t.side == 1, -10, -6,
+                                       delay=2)]))
+    assert any(d.code == "WF111" and "delay" in d.message
+               for d in rep.errors)
+
+
+def test_wf111_two_input_ts_dtype_disagreement():
+    op = IntervalJoin(lambda t: t.side == 1, 0, 4,
+                      ts_l=lambda t: t.v.astype(jnp.float32),
+                      ts_r=lambda t: t.v)
+    rep = validate(_pipe([op]))
+    assert any(d.code == "WF111" and "dtype" in d.message
+               for d in rep.errors)
+
+
+def test_wf112_session_gap_under_cb_only_source():
+    op = SessionWindow(lambda t: t.v, WindowSpec.session(3), num_keys=4)
+    rep = validate(_pipe([op], ts_fn=None))
+    assert any(d.code == "WF112" for d in rep.errors)
+
+
+def test_wf112_record_source_without_ts_field():
+    rec_dtype = np.dtype([("k", np.int32), ("v", np.float32)])
+    src = wf.RecordSource(lambda: iter(()), rec_dtype, key_field="k",
+                          num_keys=4)
+    op = SessionWindow(lambda t: t.v, WindowSpec.session(3), num_keys=4)
+    rep = validate(wf.Pipeline(src, [op], wf.Sink(lambda v: None),
+                               batch_size=16))
+    assert any(d.code == "WF112" for d in rep.errors)
+    # ts_field present: clean
+    rec2 = np.dtype([("k", np.int32), ("t", np.int32), ("v", np.float32)])
+    src2 = wf.RecordSource(lambda: iter(()), rec2, key_field="k",
+                           ts_field="t", num_keys=4)
+    rep2 = validate(wf.Pipeline(src2, [SessionWindow(
+        lambda t: t.v, WindowSpec.session(3), num_keys=4)],
+        wf.Sink(lambda v: None), batch_size=16))
+    assert "WF112" not in rep2.codes()
+    # event time present: clean
+    rep2 = validate(_pipe([SessionWindow(lambda t: t.v,
+                                         WindowSpec.session(3),
+                                         num_keys=4)]))
+    assert "WF112" not in rep2.codes()
+
+
+def test_wf111_wf112_clean_on_good_config():
+    rep = validate(_pipe([IntervalJoin(lambda t: t.side == 1, 0, 4)]))
+    assert "WF111" not in rep.codes() and "WF112" not in rep.codes()
+
+
+def test_graph_join_with_traces_sources_through_merge():
+    g = wf.PipeGraph(batch_size=32)
+    mk = lambda: wf.Source(lambda i: {"side": (i % 2).astype(I32),
+                                      "v": (i * 1).astype(I32)},
+                           total=64, num_keys=4)
+    a, b = g.add_source(mk()), g.add_source(mk())
+    m = a.join_with(b, IntervalJoin(lambda t: t.side == 1, 5, 2))
+    m.add_sink(wf.Sink(lambda v: None))
+    rep = validate(g)
+    assert any(d.code == "WF111" for d in rep.errors)
+
+
+def test_join_with_rejects_non_join_operator():
+    g = wf.PipeGraph(batch_size=32)
+    mk = lambda: wf.Source(lambda i: {"v": (i * 1).astype(I32)}, total=8,
+                           num_keys=2)
+    a, b = g.add_source(mk()), g.add_source(mk())
+    with pytest.raises(TypeError, match="join_with"):
+        a.join_with(b, wf.Map(lambda t: {"v": t.v}))

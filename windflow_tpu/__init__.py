@@ -17,7 +17,8 @@ from .shipper import Shipper
 from .operators import (Basic_Operator, Source, DeviceSource, GeneratorSource,
                         RecordSource,
                         Map, KeyedMap, KeyBy, Filter, FilterMap, Compact, FlatMap,
-                        Accumulator, Sink, ReduceSink)
+                        Accumulator, StreamTableJoin, IntervalJoin,
+                        SessionWindow, TopN, Distinct, Sink, ReduceSink)
 from .operators.map import BatchMap
 from .operators.window import WindowSpec, Iterable
 from .operators.win_seq import Win_Seq

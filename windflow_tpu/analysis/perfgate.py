@@ -50,7 +50,9 @@ PROXY_FACTOR = 3.0
 
 #: compile capacities per workload — small enough that the CPU-backend AOT
 #: compile stays test-budget friendly, pinned in the baseline for honesty
-WORKLOAD_CAPACITY = {"ysb": 2048, "mp_matrix": 1024}
+WORKLOAD_CAPACITY = {"ysb": 2048, "mp_matrix": 1024,
+                     "nexmark_join": 512, "nexmark_session": 512,
+                     "nexmark_topn": 512}
 
 #: scan-dispatch workloads: (base workload, K) — the K-fused
 #: ``CompiledChain._scan_fn`` program AOT-lowered and pinned beside the
@@ -103,9 +105,38 @@ def _build_mp_matrix():
     return chain, step, cap
 
 
+def _build_nexmark(query: str, cap: int):
+    """One Nexmark query chain at the gate capacity (the ``bench.py::
+    bench_nexmark`` construction): the join pin covers the versioned
+    JoinTable upsert + registry probe, the session pin the data-dependent
+    triggerer path, the top-N pin the bitonic rank merge."""
+    from ..nexmark import make_query
+    from ..runtime.pipeline import CompiledChain
+    from ..benchmarks import device_cursor_step
+    src, ops = make_query(query, total=16 * cap)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap)
+    step = device_cursor_step(chain, src, cap)
+    return chain, step, cap
+
+
+def _build_nexmark_join():
+    return _build_nexmark("q3_enrich_join", WORKLOAD_CAPACITY["nexmark_join"])
+
+
+def _build_nexmark_session():
+    return _build_nexmark("q5_session", WORKLOAD_CAPACITY["nexmark_session"])
+
+
+def _build_nexmark_topn():
+    return _build_nexmark("q6_topn", WORKLOAD_CAPACITY["nexmark_topn"])
+
+
 WORKLOADS: Dict[str, Callable] = {
     "ysb": _build_ysb,
     "mp_matrix": _build_mp_matrix,
+    "nexmark_join": _build_nexmark_join,
+    "nexmark_session": _build_nexmark_session,
+    "nexmark_topn": _build_nexmark_topn,
 }
 
 
@@ -277,6 +308,28 @@ def proxy_microbench(reps: int = 3) -> Dict[str, dict]:
     f = jax.jit(join_probe)
     out["join_probe"] = {"elems": C, "seconds": _bench_one(f, tk, tv, probe,
                                                            ok, reps=reps)}
+
+    # join: one full versioned-JoinTable step — upsert (pending ring +
+    # LWW dominance + slot allocation) then probe through the registry's
+    # join_probe kernel. The probe kernels keep their microbench through
+    # this family (PERF_PROXY_FAMILIES coverage) even if the raw
+    # "join_probe" row ever moves.
+    CJ, KJ2, PJ = 1024, 256, 2048
+    from ..ops.lookup import join_table_init, join_table_probe, \
+        join_table_upsert
+    jt = join_table_init(KJ2, PJ, {"v": jnp.zeros((), jnp.int32)})
+    jk = jnp.asarray(rng.integers(0, KJ2, CJ).astype(np.int32))
+    jv = {"v": jnp.asarray(rng.integers(0, 1 << 20, CJ).astype(np.int32))}
+    jts = jnp.asarray(np.arange(CJ, dtype=np.int32))
+    jid = jnp.asarray(np.arange(CJ, dtype=np.int32))
+    jok = jnp.asarray(rng.random(CJ) < 0.5)
+
+    def join_step(st):
+        st = join_table_upsert(st, jk, jv, jts, jid, jok, delay=0)
+        vals, hit = join_table_probe(st, jk, ~jok)
+        return st, vals["v"], hit
+    f = jax.jit(join_step)
+    out["join"] = {"elems": CJ, "seconds": _bench_one(f, jt, reps=reps)}
 
     # dispatch: K batches through ONE fused push_many scan launch (the
     # runtime/dispatch.py hot path) — time per tuple of the fused call, with

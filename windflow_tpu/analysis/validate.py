@@ -49,6 +49,20 @@ WF109  warning   kernel impl recorded at trace time disagrees with the
                  traced with, so the toggle the operator thinks is
                  active is NOT what the program runs — the bench would
                  silently measure the same implementation twice
+WF111  error     join operator configuration the watermark machinery
+                 cannot honor: an interval join with an empty match
+                 window (lower > upper), bounds incompatible with the
+                 configured watermark delay (upper + delay < 0 — the
+                 eviction rule removes every in-window right tuple
+                 before any left probe can arrive), or a two-input join
+                 whose per-side event-time extractors resolve different
+                 dtypes over the upstream pipes' specs (a silent
+                 promotion inside every watermark compare)
+WF112  error     session-window gap under a CB-only source: every
+                 source feeding the session operator assigns no event
+                 time (ts defaults to the arrival index), so the gap —
+                 defined in event-time units — fires on arrival
+                 positions instead
 WF110  warn/err  scan dispatch (K > 1) combined with a configuration
                  the fused launch cannot honor: an unresolvable
                  ``dispatch=``/``WF_DISPATCH`` (error);
@@ -490,6 +504,113 @@ def _check_dispatch(report, dispatch, stored_arg, cfg, trace, stored_trace,
                      "group) or lower k for this topology")
 
 
+def _feeding_sources(mp) -> list:
+    """Every source transitively feeding a graph pipe (through merges and
+    split parents) — the WF112 session/event-time check needs to know
+    whether ANY upstream assigns event time."""
+    out, seen = [], set()
+
+    def visit(p):
+        if id(p) in seen:
+            return
+        seen.add(id(p))
+        if p.source is not None:
+            out.append(p.source)
+        for up in p.merge_inputs:
+            visit(up)
+        if p._dataflow_parent is not None:
+            visit(p._dataflow_parent)
+    visit(mp)
+    return out
+
+
+def _check_stream_ops(report, ops, in_spec, where_prefix: str,
+                      sources=()) -> None:
+    """WF111/WF112: join/session operator configuration against the
+    watermark machinery — spec-level only, zero device work."""
+    from ..operators.join import IntervalJoin
+    from ..operators.session import SessionWindow
+    from ..operators.source import DeviceSource
+    spec = in_spec
+    for i, op in enumerate(ops):
+        where = f"{where_prefix}.ops[{i}]:{op.getName()}"
+        if isinstance(op, IntervalJoin):
+            if op.lower > op.upper:
+                report.add(
+                    "WF111", "error", where,
+                    f"interval-join match window is empty: lower "
+                    f"{op.lower} > upper {op.upper} — no pair can ever "
+                    f"satisfy r.ts - l.ts in [lower, upper]",
+                    hint="swap the bounds (lower <= upper); [0, W] matches "
+                         "rights up to W ticks after their left")
+            elif op.upper + op.delay < 0:
+                report.add(
+                    "WF111", "error", where,
+                    f"interval-join bounds are incompatible with the "
+                    f"configured watermark delay: upper {op.upper} + delay "
+                    f"{op.delay} < 0, so the eviction rule (keep r.ts >= "
+                    f"wm - delay + lower) removes every in-window right "
+                    f"tuple before any left probe can arrive",
+                    hint="raise delay to at least -upper (the lateness the "
+                         "backward-looking window implies), or widen upper")
+            if ((op.ts_l is not None or op.ts_r is not None)
+                    and spec is not None):
+                ref = TupleRef(key=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+                               id=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+                               ts=jax.ShapeDtypeStruct((), CTRL_DTYPE),
+                               data=spec)
+                try:
+                    dl = (jax.eval_shape(op.ts_l, ref).dtype
+                          if op.ts_l is not None else CTRL_DTYPE)
+                    dr = (jax.eval_shape(op.ts_r, ref).dtype
+                          if op.ts_r is not None else CTRL_DTYPE)
+                except Exception as e:  # noqa: BLE001 — surfaced as WF111
+                    report.add("WF111", "error", where,
+                               f"event-time extractor rejects the upstream "
+                               f"payload spec: {type(e).__name__}: {e}")
+                else:
+                    if dl != dr:
+                        report.add(
+                            "WF111", "error", where,
+                            f"the two join inputs disagree on timestamp "
+                            f"dtype: left extractor resolves {dl}, right "
+                            f"resolves {dr} — every watermark compare "
+                            f"would silently promote one side",
+                            hint="cast both extractors to one dtype "
+                                 "(int32 event time is the control-field "
+                                 "contract)")
+        spec_attr = getattr(op, "spec", None)
+        if (isinstance(op, SessionWindow)
+                or getattr(spec_attr, "is_session", False)):
+            from ..operators.source import RecordSource
+
+            def _no_event_time(s):
+                # ts defaults to the arrival index: DeviceSource without a
+                # ts_fn, RecordSource without a ts_field. GeneratorSource
+                # items MAY carry (payload, key, ts) triples — unknowable
+                # statically, so it never triggers the diagnostic.
+                if isinstance(s, RecordSource):
+                    return s.ts_field is None
+                if isinstance(s, DeviceSource):
+                    return s.ts_fn is None
+                return False
+            if sources and all(_no_event_time(s) for s in sources):
+                report.add(
+                    "WF112", "error", where,
+                    f"session gap ({spec_attr.gap if spec_attr else '?'}) "
+                    f"under a CB-only source: every source feeding this "
+                    f"operator assigns no event time (ts defaults to the "
+                    f"tuple index), so the gap — an event-time quantity — "
+                    f"would fire on arrival positions",
+                    hint="give the source a ts_fn (DeviceSource) / ts "
+                         "column (GeneratorSource ts triple, RecordSource "
+                         "ts_field) carrying real event time")
+        try:
+            spec = op.out_spec(spec) if spec is not None else None
+        except Exception:  # noqa: BLE001 — already diagnosed as WF101
+            spec = None
+
+
 def _resolve_control(explicit, stored):
     from ..control import ControlConfig
     if explicit is not None:
@@ -540,6 +661,7 @@ def _validate_pipeline(report, p, faults, control, supervised,
     # budget-derived archive sizes)
     _validate_chain_ops(report, p.chain.ops, in_spec, None, "pipeline",
                         sink=p.sink)
+    _check_stream_ops(report, p.chain.ops, in_spec, "pipeline", [p.source])
     _check_faults(report, faults, "supervised" if supervised else "pipeline")
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(p, "_trace_arg", None), supervised)
@@ -556,6 +678,8 @@ def _validate_supervised(report, sp, faults, control, trace=None,
         return
     _validate_chain_ops(report, sp.chain.ops, in_spec, None, "supervised",
                         sink=sp.sink)
+    _check_stream_ops(report, sp.chain.ops, in_spec, "supervised",
+                      [sp.source])
     _check_faults(report, faults if faults is not None
                   else getattr(sp, "_faults_arg", None), "supervised")
     _check_admission(report, cfg, True, "control.admission")
@@ -573,6 +697,7 @@ def _validate_threaded(report, tp, faults, control, supervised,
         return
     for i, chain in enumerate(tp.chains):
         # capacity None: segment chains were geometry-bound at construction
+        _check_stream_ops(report, chain.ops, spec, f"seg{i}", [tp.source])
         spec, _cap = _flow_ops(report, chain.ops, spec, f"seg{i}", None)
         if spec is None:
             break
@@ -663,6 +788,8 @@ def _validate_graph(report, g, faults, control, supervised,
             in_cap = out_caps.get(id(parent))
             if in_spec is None:
                 continue               # upstream already diagnosed
+        _check_stream_ops(report, mp.ops, in_spec, where,
+                          _feeding_sources(mp))
         out, out_cap = _flow_ops(report, mp.ops, in_spec, where, in_cap)
         out_specs[id(mp)] = out
         if out_cap is not None:

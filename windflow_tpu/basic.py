@@ -33,10 +33,15 @@ class Mode(enum.Enum):
 
 
 class win_type_t(enum.Enum):
-    """Window type: count-based or time-based (``wf/basic.hpp:89``)."""
+    """Window type: count-based, time-based (``wf/basic.hpp:89``), or
+    session (data-dependent gap — an extension beyond the reference's fixed
+    CB/TB lattice; the survey's operator taxonomy, PAPER.md §2.4, lists
+    session windows as the third firing family every production stream
+    system carries)."""
 
     CB = 0
     TB = 1
+    SESSION = 2
 
 
 class opt_level_t(enum.Enum):

@@ -82,6 +82,26 @@ def run_sweep(batches=(1 << 16, 1 << 18, 1 << 20), keyset=(1, 500, 10_000),
     return rows
 
 
+def run_nexmark(batches=(1 << 12, 1 << 14), steps: int = 20
+                ) -> List[Tuple[str, int, int, float]]:
+    """Sweep rows for every Nexmark query (``names.py::NEXMARK_QUERIES``):
+    the scenario-diversity table beside the classic four workloads. The key
+    column reports each query's own key domain (auctions/bidders are fixed
+    by the generators, not swept)."""
+    from ..nexmark import QUERIES, make_query
+    from ..nexmark import generators as g
+    keys_of = {"q5_session": g.N_BIDDERS}
+    rows = []
+    for batch in batches:
+        for name in QUERIES:
+            src, ops = make_query(name, total=(steps + 2) * batch)
+            step, states = _chain_step(ops, src, batch)
+            tps = _throughput(step, states, steps, batch)
+            rows.append((f"nexmark:{name}", batch,
+                         keys_of.get(name, g.N_AUCTIONS), tps))
+    return rows
+
+
 def run_adaptive(batches=(1 << 16, 1 << 18, 1 << 20), keyset=(1, 500, 10_000),
                  names=("map_stateless", "map_stateful", "filter", "win_kf"),
                  steps: int = 20, cache_path=None,
@@ -163,6 +183,8 @@ def main(argv=None):
     ap.add_argument("--out", default="RESULTS.md")
     ap.add_argument("--no-adaptive", action="store_true",
                     help="skip the autotuned rows (fixed-ladder sweep only)")
+    ap.add_argument("--no-nexmark", action="store_true",
+                    help="skip the Nexmark query rows")
     ap.add_argument("--tuning-cache", default=None,
                     help="tuning-cache path for the adaptive rows (a second "
                     "run warm-starts at the cached optimum)")
@@ -170,6 +192,8 @@ def main(argv=None):
     rows = run_sweep(steps=args.steps)
     if not args.no_adaptive:
         rows += run_adaptive(steps=args.steps, cache_path=args.tuning_cache)
+    if not args.no_nexmark:
+        rows += run_nexmark(steps=args.steps)
     md = render_markdown(rows, str(jax.devices()[0]))
     with open(args.out, "w") as f:
         f.write(md)

@@ -1,0 +1,27 @@
+"""Nexmark-style benchmark suite — the workload face of the join/session/
+rank operator family.
+
+The Nexmark continuous-query benchmark (auctions / bids / persons) is the
+standard scenario battery beyond YSB; this package carries a TPU-native
+restatement sized to the framework's micro-batch model:
+
+- :mod:`generators` — synthetic on-device event sources (bid stream, tagged
+  auction+bid streams for the join queries), all ``DeviceSource`` fast-path
+  (generation fuses into the compiled chain, zero H2D).
+- :mod:`queries` — one builder per query in
+  ``observability/names.py::NEXMARK_QUERIES`` (currency-map, selection-
+  filter, stream-table enrichment join, interval join, session aggregate,
+  top-N-by-key, distinct), each returning ``(source, ops)`` ready for any
+  driver.
+- :mod:`oracles` — dense host-side oracles (exact expected outputs, the
+  ``tests/test_ysb.py`` style) for every query.
+
+Wired into ``bench.py::bench_nexmark``, ``benchmarks/sweep.py`` and the
+hermetic perf gate (``analysis/perfgate.py`` ``nexmark_*`` cost pins) so
+every query lands in the capture + trend machinery.
+"""
+
+from . import generators, oracles, queries
+from .queries import QUERIES, make_query
+
+__all__ = ["generators", "oracles", "queries", "QUERIES", "make_query"]

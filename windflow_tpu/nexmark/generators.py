@@ -1,0 +1,96 @@
+"""Nexmark event generators — deterministic on-device synthetic streams.
+
+All sources are ``DeviceSource`` (generation fuses into the compiled chain).
+Event-time advances ``EVENTS_PER_TICK`` events per tick, the YSB convention.
+The tagged sources interleave two logical streams into ONE schema-unified
+stream (``side`` payload field), which is exactly the shape a two-input
+``PipeGraph`` merge produces — so the same queries run single-pipe (the
+bench/test fast path) or as genuine two-pipe merges (``MultiPipe.
+join_with``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..operators.source import DeviceSource
+
+EVENTS_PER_TICK = 8     # ts = i // EVENTS_PER_TICK
+N_AUCTIONS = 16
+N_BIDDERS = 8
+N_CATEGORIES = 7
+PRICE_MOD = 9973        # pseudo-random bid price: (i * 7919) % PRICE_MOD + 100
+OPEN_EVERY = 16         # every OPEN_EVERY-th event of the tagged join stream
+                        # opens an auction (the interval-join left side)
+
+
+def _i32(x):
+    return jnp.asarray(x, jnp.int32)
+
+
+def bid_auction(i):
+    return (i * 2477) % N_AUCTIONS
+
+
+def bid_bidder(i):
+    # deliberately irregular per-bidder inter-arrival times: session gaps
+    # must be data-dependent, not a fixed lattice
+    return ((i % 7) * (i % 11) + i // 13) % N_BIDDERS
+
+
+def bid_price(i):
+    return (i * 7919) % PRICE_MOD + 100
+
+
+def make_bid_source(total: int, name: str = "nexmark_bids") -> DeviceSource:
+    """The plain bid stream: ``{auction, bidder, price}`` keyed by auction."""
+    def gen(i):
+        return {"auction": _i32(bid_auction(i)),
+                "bidder": _i32(bid_bidder(i)),
+                "price": _i32(bid_price(i))}
+    return DeviceSource(gen, total=total, name=name,
+                        key_fn=lambda i: bid_auction(i),
+                        ts_fn=lambda i: i // EVENTS_PER_TICK)
+
+
+def make_enrich_source(total: int,
+                       name: str = "nexmark_enrich") -> DeviceSource:
+    """Tagged stream for the stream-table join: events ``0..N_AUCTIONS-1``
+    are auction definitions (``side == 1``, ``category`` set), the rest are
+    bids (``side == 0``). Definitions strictly precede every bid in event
+    time, so probe results are invariant to batching (the as-of-watermark
+    read sees every definition)."""
+    def gen(i):
+        is_def = i < N_AUCTIONS
+        auction = jnp.where(is_def, i, bid_auction(i))
+        return {"side": jnp.where(is_def, 1, 0).astype(jnp.int32),
+                "auction": _i32(auction),
+                "category": jnp.where(is_def, (i * 13) % N_CATEGORIES,
+                                      0).astype(jnp.int32),
+                "price": jnp.where(is_def, 0,
+                                   bid_price(i)).astype(jnp.int32)}
+    return DeviceSource(gen, total=total, name=name,
+                        key_fn=lambda i: jnp.where(i < N_AUCTIONS, i,
+                                                   bid_auction(i)),
+                        ts_fn=lambda i: i // EVENTS_PER_TICK)
+
+
+def make_open_bid_source(total: int,
+                         name: str = "nexmark_open_bid") -> DeviceSource:
+    """Tagged stream for the interval join: every ``OPEN_EVERY``-th event
+    opens an auction (``side == 1``), the rest are bids — a bid matches an
+    open of the same auction within the join's ``[0, upper]`` tick window."""
+    def gen(i):
+        is_open = (i % OPEN_EVERY) == 0
+        auction = jnp.where(is_open, (i // OPEN_EVERY) % N_AUCTIONS,
+                            bid_auction(i))
+        return {"side": jnp.where(is_open, 1, 0).astype(jnp.int32),
+                "auction": _i32(auction),
+                "price": jnp.where(is_open, 0,
+                                   bid_price(i)).astype(jnp.int32)}
+    def key(i):
+        is_open = (i % OPEN_EVERY) == 0
+        return jnp.where(is_open, (i // OPEN_EVERY) % N_AUCTIONS,
+                         bid_auction(i))
+    return DeviceSource(gen, total=total, name=name, key_fn=key,
+                        ts_fn=lambda i: i // EVENTS_PER_TICK)

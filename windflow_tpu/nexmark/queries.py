@@ -1,0 +1,127 @@
+"""Nexmark query builders — one per ``names.py::NEXMARK_QUERIES`` entry.
+
+Each builder returns ``(source, ops)``: attach any sink and run under any
+driver (plain / threaded / supervised / graph). Defaults are sized for
+correctness tests; bench/perf-gate callers pass their own ``total``.
+
+Query map (the classic Nexmark numbers, restated for this event model):
+
+====================  ===================================================
+q1_currency           per-bid dollar -> euro projection (currency map)
+q2_selection          selection filter: auctions of interest
+q3_enrich_join        stream-table join: bid enriched with its auction's
+                      category through the versioned JoinTable (the
+                      registry ``join_probe`` production call site)
+q4_interval_join      interval join: bid matches an auction-open event of
+                      the same auction within ``[0, JOIN_WINDOW]`` ticks
+q5_session            session aggregate: per-bidder bid count + price sum
+                      per activity session (gap ``SESSION_GAP`` ticks)
+q6_topn               incremental top-``TOP_N`` bid prices per auction
+q7_distinct           first bid per selected auction (distinct)
+====================  ===================================================
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..observability.names import NEXMARK_QUERIES as QUERIES
+from ..operators.filter import Filter
+from ..operators.join import IntervalJoin, StreamTableJoin
+from ..operators.map import KeyBy, Map
+from ..operators.rank import Distinct, TopN
+from ..operators.session import SessionWindow
+from ..operators.window import WindowSpec
+from . import generators as g
+
+#: euro conversion: integer, exact (the reference multiplies by 0.89)
+EURO_NUM, EURO_DEN = 89, 100
+#: q2/q7 selection predicate: auctions divisible by this
+SELECT_MOD = 4
+#: q4 interval-join window, ticks
+JOIN_WINDOW = 4
+#: q5 session gap, ticks
+SESSION_GAP = 2
+#: q6 leaderboard depth
+TOP_N = 3
+
+
+def q1_currency(total: int):
+    src = g.make_bid_source(total)
+    ops = [Map(lambda t: {"auction": t.auction,
+                          "euro": (t.price * EURO_NUM) // EURO_DEN},
+               name="nexmark_currency")]
+    return src, ops
+
+
+def q2_selection(total: int):
+    src = g.make_bid_source(total)
+    ops = [Filter(lambda t: t.auction % SELECT_MOD == 0,
+                  name="nexmark_select")]
+    return src, ops
+
+
+def q3_enrich_join(total: int):
+    src = g.make_enrich_source(total)
+    ops = [StreamTableJoin(
+        lambda t: t.side == 1,                 # auction definitions build
+        lambda t: t.auction,
+        lambda t: {"category": t.category},    # the enrichment column
+        num_slots=g.N_AUCTIONS, name="nexmark_enrich_join")]
+    return src, ops
+
+
+def q4_interval_join(total: int, max_matches: int = 8):
+    src = g.make_open_bid_source(total)
+    ops = [IntervalJoin(
+        lambda t: t.side == 1,                 # auction opens are the left
+        0, JOIN_WINDOW, max_matches=max_matches,
+        emit=lambda l, r: {"auction": l.data["auction"],
+                           "open_ts": l.ts, "bid_ts": r.ts,
+                           "price": r.data["price"]},
+        name="nexmark_interval_join")]
+    return src, ops
+
+
+def q5_session(total: int):
+    src = g.make_bid_source(total)
+    ops = [KeyBy(lambda t: t.bidder, g.N_BIDDERS, name="nexmark_by_bidder"),
+           SessionWindow(lambda t: {"bids": jnp.ones((), jnp.int32),
+                                    "spend": t.price},
+                         WindowSpec.session(SESSION_GAP),
+                         num_keys=g.N_BIDDERS, name="nexmark_session")]
+    return src, ops
+
+
+def q6_topn(total: int):
+    src = g.make_bid_source(total)
+    ops = [TopN(lambda t: t.price, TOP_N, num_keys=g.N_AUCTIONS,
+                name="nexmark_topn")]
+    return src, ops
+
+
+def q7_distinct(total: int):
+    src = g.make_bid_source(total)
+    ops = [Filter(lambda t: t.auction % SELECT_MOD == 0,
+                  name="nexmark_select"),
+           Distinct(lambda t: t.auction, num_slots=g.N_AUCTIONS,
+                    name="nexmark_distinct")]
+    return src, ops
+
+
+_BUILDERS = {
+    "q1_currency": q1_currency,
+    "q2_selection": q2_selection,
+    "q3_enrich_join": q3_enrich_join,
+    "q4_interval_join": q4_interval_join,
+    "q5_session": q5_session,
+    "q6_topn": q6_topn,
+    "q7_distinct": q7_distinct,
+}
+
+assert set(_BUILDERS) == set(QUERIES), "queries drifted from names.py"
+
+
+def make_query(name: str, total: int, **kw):
+    """``(source, ops)`` for one registered query name."""
+    return _BUILDERS[name](total, **kw)

@@ -82,6 +82,10 @@ CONTROL_COUNTERS = (
     "admitted_batches", "admitted_tuples", "shed_batches", "shed_tuples",
     "throttle_events", "throttle_seconds", "capacity_switches",
     "tuning_decisions", "tuning_cache_hits",
+    # nexmark-class operator family (operators/session.py, operators/
+    # rank.py): sessions closed by the data-dependent triggerer, and
+    # leaderboard candidates evicted by the top-N rank merge
+    "sessions_closed", "topn_evictions",
 )
 
 #: control-plane gauges (``control/_state.py::set_gauge``; Prometheus
@@ -92,6 +96,10 @@ CONTROL_GAUGES = (
     # autotuner's K ladder): batches buffered awaiting a fused launch, and
     # the K rung the dispatch tuner currently runs
     "dispatch_linger_depth", "dispatch_k",
+    # versioned join-state table (ops/lookup.py join_table_*): applied
+    # upsert count of the most recently synced table (last-write-wins
+    # across tables, the chosen_capacity convention)
+    "join_table_version",
 )
 
 #: kernel families selectable through the per-backend kernel registry
@@ -118,6 +126,25 @@ KERNELS = (
 #: ``tests/test_perfgate.py`` asserts.
 PERF_PROXY_FAMILIES = (
     "dispatch",
+    # "join" times the full versioned JoinTable step (upsert + registry
+    # probe, ops/lookup.py join_table_*) — the probe kernels keep their
+    # microbench or tests/test_perfgate.py fails coverage
+    "join",
+)
+
+#: Nexmark-style benchmark queries (``windflow_tpu/nexmark/queries.py``).
+#: THE name registry for the workload suite: ``bench.py::bench_nexmark``,
+#: ``benchmarks/sweep.py``, the perf-gate nexmark workload pins, and
+#: ``tests/test_nexmark.py``'s dense oracles all enumerate this tuple, so a
+#: query added to the package without bench/test coverage fails loudly.
+NEXMARK_QUERIES = (
+    "q1_currency",       # currency-map: per-bid dollar -> euro projection
+    "q2_selection",      # selection-filter: auctions of interest
+    "q3_enrich_join",    # stream-table join: bid -> auction category
+    "q4_interval_join",  # interval join: bid within an auction's open window
+    "q5_session",        # session-aggregate: per-bidder activity sessions
+    "q6_topn",           # top-N-by-key: highest bids per auction
+    "q7_distinct",       # distinct: first bid per selected auction
 )
 
 #: implementation names a kernel may register under (WF250 checks literal

@@ -4,10 +4,14 @@ from .map import Map, KeyedMap, KeyBy
 from .filter import Filter, FilterMap, Compact
 from .flatmap import FlatMap
 from .accumulator import Accumulator
+from .join import StreamTableJoin, IntervalJoin
+from .session import SessionWindow
+from .rank import TopN, Distinct
 from .sink import Sink, ReduceSink
 
 __all__ = [
     "Basic_Operator", "Source", "DeviceSource", "GeneratorSource", "RecordSource", "SourceBase",
     "Map", "KeyedMap", "KeyBy", "Filter", "FilterMap", "Compact", "FlatMap",
-    "Accumulator", "Sink", "ReduceSink",
+    "Accumulator", "StreamTableJoin", "IntervalJoin", "SessionWindow",
+    "TopN", "Distinct", "Sink", "ReduceSink",
 ]

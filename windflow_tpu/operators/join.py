@@ -1,0 +1,365 @@
+"""Streaming joins: stream-table enrichment and interval (stream-stream) join.
+
+The reference's operator taxonomy (PAPER.md survey §2.4) lists joins as the
+first operator family this repro did not exercise: WindFlow itself joins
+per tuple against in-memory hash maps (the YSB campaign join,
+``src/yahoo_test_cpu``), and every production stream system beyond it needs
+stream-table and interval joins. TPU formulation:
+
+- **Two-input wiring** rides ``PipeGraph`` merge semantics: both inputs merge
+  into one pipe (identical payload specs — the ``wf/pipegraph.hpp:1573-1578``
+  typeid check) and the join operator separates the sides per tuple with a
+  ``side_fn`` over the unified schema (``MultiPipe.join_with`` packages the
+  merge + add). Under ``Mode.DETERMINISTIC`` the merge's Ordering_Node makes
+  the interleave — and therefore the join — byte-identical across drivers.
+- :class:`StreamTableJoin` probes the **versioned, watermark-consistent
+  JoinTable** of ``ops/lookup.py`` (``join_table_*``): build-side tuples
+  upsert (versioned by event time, last-writer-wins), probe-side tuples read
+  the table as-of the build watermark through the kernel registry's
+  ``join_probe`` kernel — the production call site the round-5 Pallas probe
+  was waiting for. Probing a table above the Pallas ``K <= 2048`` envelope
+  routes to the XLA reference inside the kernel call (never raises).
+- :class:`IntervalJoin` holds both sides in bounded on-device archives and
+  matches each arriving tuple against the opposite archive with one fused
+  ``[C, A]`` compare + masked select-reduce — the same contraction shape as
+  the probe kernel, so the whole match stage fuses into the chain's single
+  device program (the amortization argument of arXiv:1305.1183). A pair is
+  emitted exactly once, when its later tuple arrives.
+
+Both operators' state is a plain pytree — checkpoints, supervised replay and
+the exactly-once outbox carry it with zero new machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
+from ..batch import Batch, CTRL_DTYPE, TupleRef, tuple_refs
+from ..ops.lookup import (JOIN_KEY_SENTINEL, join_table_init,
+                          join_table_probe, join_table_upsert)
+from .base import Basic_Operator
+
+_IMIN = -(1 << 31)
+
+
+def _ref_spec(payload_spec):
+    s = jax.ShapeDtypeStruct((), CTRL_DTYPE)
+    return TupleRef(key=s, id=s, ts=s, data=payload_spec)
+
+
+def _default_pair_emit(l: TupleRef, r: TupleRef):
+    """Union payload of a matched pair: dict payloads merge under ``l_``/
+    ``r_`` prefixes; other pytrees nest under ``{"l": ..., "r": ...}``."""
+    if isinstance(l.data, dict) and isinstance(r.data, dict):
+        out = {f"l_{k}": v for k, v in l.data.items()}
+        out.update({f"r_{k}": v for k, v in r.data.items()})
+        return out
+    return {"l": l.data, "r": r.data}
+
+
+class StreamTableJoin(Basic_Operator):
+    """Stream-table join over one merged (tagged) stream.
+
+    ``side_fn(t) -> bool`` marks **build**-side tuples (table upserts);
+    everything else probes. ``key_fn(t) -> i32`` extracts the join key on
+    both sides (keys must be > INT32_MIN); ``val_fn(t) -> pytree of
+    scalars`` extracts the build-side value columns. ``emit(t, v) ->
+    payload`` shapes the probe-side output (default: merge the probe payload
+    with the value dict). ``delay`` is the build-side lateness allowance: an
+    upsert becomes probe-visible once the build watermark passes
+    ``ts + delay``, so probes read the table **as-of the watermark** —
+    deterministic under any arrival interleave the watermark contract
+    admits. Duplicate-key upserts are last-writer-wins by ``(ts, id)``.
+
+    Misses emit zero values with ``hit`` False; by default miss lanes are
+    masked out (inner join) — ``emit_misses=True`` keeps them (left join)."""
+
+    routing = routing_modes_t.KEYBY
+
+    def __init__(self, side_fn: Callable, key_fn: Callable, val_fn: Callable,
+                 *, num_slots: int = DEFAULT_MAX_KEYS,
+                 pending: Optional[int] = None, delay: int = 0,
+                 emit: Optional[Callable] = None, emit_misses: bool = False,
+                 name: str = "stream_table_join", parallelism: int = 1):
+        super().__init__(name, parallelism)
+        if delay < 0:
+            raise ValueError(f"{name}: delay (lateness) must be >= 0")
+        self.side_fn = side_fn
+        self.key_fn = key_fn
+        self.val_fn = val_fn
+        self.num_slots = int(num_slots)
+        self.pending = None if pending is None else int(pending)
+        self.delay = int(delay)
+        self.emit_misses = bool(emit_misses)
+        self.emit = emit
+        self._pending_resolved = pending
+        self._version_synced = 0
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        if self.pending is None:
+            # one batch of pure build tuples must always fit, with headroom
+            # for upserts parked behind a nonzero delay
+            self._pending_resolved = 2 * int(batch_capacity)
+        else:
+            self._pending_resolved = self.pending
+
+    def _emit(self, t: TupleRef, v):
+        if self.emit is not None:
+            return self.emit(t, v)
+        if isinstance(t.data, dict) and isinstance(v, dict):
+            return {**t.data, **v}
+        return {"probe": t.data, "join": v}
+
+    def _val_spec(self, payload_spec):
+        return jax.eval_shape(self.val_fn, _ref_spec(payload_spec))
+
+    def init_state(self, payload_spec: Any):
+        pending = self._pending_resolved or 2 * DEFAULT_MAX_KEYS
+        return join_table_init(self.num_slots, pending,
+                               self._val_spec(payload_spec))
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        vspec = self._val_spec(payload_spec)
+        return jax.eval_shape(self._emit, _ref_spec(payload_spec), vspec)
+
+    def apply(self, state, batch: Batch):
+        refs = tuple_refs(batch)
+        build = jax.vmap(self.side_fn)(refs).astype(jnp.bool_) & batch.valid
+        probe_mask = batch.valid & ~build
+        jkey = jax.vmap(self.key_fn)(refs).astype(jnp.int32)
+        bval = jax.vmap(self.val_fn)(refs)
+        # upsert BEFORE probe: a probe sees every build tuple up to and
+        # including its own batch (the as-of-watermark read point)
+        state = join_table_upsert(state, jkey, bval, batch.ts, batch.id,
+                                  build, delay=self.delay)
+        vals, hit = join_table_probe(state, jkey, probe_mask)
+        payload = jax.vmap(self._emit)(refs, vals)
+        valid = probe_mask & (hit | self.emit_misses)
+        return state, batch.replace(payload=payload, valid=valid)
+
+    def collect_stats(self, state: Any = None) -> None:
+        if state is None:
+            return
+        import numpy as np
+        from ..control import _state as _cstate
+        v = int(np.asarray(state["version"]))
+        if v != self._version_synced:
+            self._version_synced = v
+            _cstate.set_gauge("join_table_version", float(v))
+
+
+class IntervalJoin(Basic_Operator):
+    """Interval (stream-stream) join over one merged (tagged) stream.
+
+    A pair ``(l, r)`` matches when ``l.key == r.key`` and
+    ``r.ts - l.ts in [lower, upper]`` — the match window is expressed
+    against the same event-time/watermark machinery ``WindowSpec.fired_hi_tb``
+    uses: both archives evict exactly the tuples the watermark proves can no
+    longer match (``l.ts < wm - delay - upper``, ``r.ts < wm - delay +
+    lower``). Each arriving tuple probes the opposite archive (plus, for the
+    left side, the batch's own right tuples), so every pair is emitted
+    exactly once, when its later member arrives — the emitted multiset is
+    batching-invariant. Up to ``max_matches`` matches per probing tuple are
+    kept (candidate order: archive slot, then batch lane — deterministic);
+    overflow is counted in ``state["match_drops"]``. ``ts_l``/``ts_r``
+    optionally extract per-side event time from the payload (the two-input
+    dtype contract ``validate()``'s WF111 checks pre-run).
+
+    ``emit(l, r) -> payload`` shapes the output (default: ``l_``/``r_``
+    prefixed union). Output capacity is ``2 * C * max_matches`` (one
+    ``max_matches`` budget per probing lane, both directions)."""
+
+    routing = routing_modes_t.KEYBY
+
+    def __init__(self, side_fn: Callable, lower: int, upper: int, *,
+                 archive: Optional[int] = None, max_matches: int = 4,
+                 delay: int = 0, emit: Optional[Callable] = None,
+                 ts_l: Optional[Callable] = None,
+                 ts_r: Optional[Callable] = None,
+                 name: str = "interval_join", parallelism: int = 1):
+        super().__init__(name, parallelism)
+        self.side_fn = side_fn
+        self.lower = int(lower)
+        self.upper = int(upper)
+        self.archive = None if archive is None else int(archive)
+        self.max_matches = int(max_matches)
+        self.delay = int(delay)
+        self.emit = emit or _default_pair_emit
+        self.ts_l = ts_l
+        self.ts_r = ts_r
+        if self.max_matches < 1:
+            raise ValueError(f"{name}: max_matches must be >= 1")
+        if self.delay < 0:
+            raise ValueError(f"{name}: delay (lateness) must be >= 0")
+        self._archive_resolved = archive
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        a = self.archive if self.archive is not None \
+            else 2 * int(batch_capacity)
+        if a < batch_capacity:
+            raise ValueError(
+                f"{self.name}: archive={a} < batch capacity "
+                f"{batch_capacity} — one batch's ring writes would collide "
+                f"(size archive >= the batch capacity)")
+        self._archive_resolved = int(a)
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return 2 * in_capacity * self.max_matches
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        r = _ref_spec(payload_spec)
+        return jax.eval_shape(self.emit, r, r)
+
+    def init_state(self, payload_spec: Any):
+        A = self._archive_resolved or 2 * DEFAULT_MAX_KEYS
+
+        def side():
+            return {
+                "key": jnp.full((A,), JOIN_KEY_SENTINEL, jnp.int32),
+                "ts": jnp.zeros((A,), jnp.int32),
+                "id": jnp.zeros((A,), jnp.int32),
+                "ok": jnp.zeros((A,), jnp.bool_),
+                "pay": jax.tree.map(
+                    lambda s: jnp.zeros((A,) + tuple(s.shape), s.dtype),
+                    payload_spec),
+            }
+        return {"l": side(), "r": side(),
+                "lcur": jnp.asarray(0, jnp.int32),
+                "rcur": jnp.asarray(0, jnp.int32),
+                "wm": jnp.asarray(_IMIN, jnp.int32),
+                "match_drops": jnp.asarray(0, jnp.int32),
+                "arch_drops": jnp.asarray(0, jnp.int32)}
+
+    def _event_ts(self, refs, is_l, batch):
+        if self.ts_l is None and self.ts_r is None:
+            return batch.ts
+        tl = (jax.vmap(self.ts_l)(refs) if self.ts_l is not None
+              else batch.ts)
+        tr = (jax.vmap(self.ts_r)(refs) if self.ts_r is not None
+              else batch.ts)
+        return jnp.where(is_l, tl.astype(jnp.int32), tr.astype(jnp.int32))
+
+    def _probe(self, pmask, pkey, pts, ck, cts, cid, cok, cpay):
+        """Match probing lanes against a candidate set: returns
+        (matched bool[C*M], picked (key, ts, id, payload)[C*M], overflow)."""
+        M = self.max_matches
+        diff = cts[None, :] - pts[:, None]
+        m = (pmask[:, None] & cok[None, :]
+             & (pkey[:, None] == ck[None, :])
+             & (diff >= 0) & (diff <= self.upper - self.lower))
+        # NOTE: callers pre-shift pts so the window is [0, upper-lower]
+        rank = jnp.cumsum(m.astype(jnp.int32), axis=1) - 1
+        cnt = jnp.sum(m.astype(jnp.int32), axis=1)
+        overflow = jnp.sum(jnp.maximum(cnt - M, 0))
+        matched, ks, xs, ids, pays = [], [], [], [], []
+        for mm in range(M):
+            sel = m & (rank == mm)                       # [C, Ncand] one-hot
+
+            def pick(a):
+                s = sel.reshape(sel.shape + (1,) * (a.ndim - 1))
+                return jnp.sum(jnp.where(s, a[None, ...],
+                                         jnp.zeros((), a.dtype)), axis=1)
+            matched.append(jnp.any(sel, axis=1))
+            ks.append(pick(ck))
+            xs.append(pick(cts))
+            ids.append(pick(cid))
+            pays.append(jax.tree.map(pick, cpay))
+        flat = lambda parts: jnp.stack(parts, axis=1).reshape(-1)
+        pay = jax.tree.map(
+            lambda *ls: jnp.stack(ls, axis=1).reshape(
+                (-1,) + ls[0].shape[1:]), *pays)
+        return (flat(matched), flat(ks), flat(xs), flat(ids), pay, overflow)
+
+    def _rows(self, batch, pmask, ets, cand, swap):
+        """Output rows of one probe direction: ``cand`` is the candidate
+        side's (key, ts, id, ok, pay); ``swap`` True when the PROBING lane is
+        the right side (candidates are archived left tuples)."""
+        M = self.max_matches
+        ck, cts, cid, cok, cpay = cand
+        # shift so _probe's [0, upper-lower] window encodes r.ts - l.ts in
+        # [lower, upper] for either probe direction: left probes ask for
+        # cand.ts - (ets + lower) in [0, span]; right probes negate the axis
+        pts = ets + self.lower if not swap else -ets + self.lower
+        cts_in = cts if not swap else -cts
+        matched, k2, x2, id2, pay2, overflow = self._probe(
+            pmask, batch.key, pts, ck, cts_in, cid, cok, cpay)
+        x2 = x2 if not swap else -x2
+        rep = lambda a: jnp.repeat(a, M, axis=0)
+        # the probing side's ref carries the EXTRACTED event time (the
+        # archive stores ets too, so the same logical pair reaches emit()
+        # with identical fields whichever member arrived later)
+        probe_ref = TupleRef(key=rep(batch.key), id=rep(batch.id),
+                             ts=rep(ets),
+                             data=jax.tree.map(rep, batch.payload))
+        cand_ref = TupleRef(key=k2, id=id2, ts=x2, data=pay2)
+        l_ref, r_ref = ((probe_ref, cand_ref) if not swap
+                        else (cand_ref, probe_ref))
+        payload = jax.vmap(self.emit)(l_ref, r_ref)
+        return (matched, rep(batch.key),
+                jnp.maximum(l_ref.ts, r_ref.ts), rep(batch.id), payload,
+                overflow)
+
+    def _append(self, side, cur, mask, key, ets, batch):
+        """Ring-append the batch's ``mask`` lanes into one side's archive;
+        returns (side, cur, live slots overwritten)."""
+        A = side["key"].shape[0]
+        csum = jnp.cumsum(mask.astype(jnp.int32))
+        pos = (cur + csum - 1) % A
+        idx = jnp.where(mask, pos, A)
+        overwrote = jnp.sum((mask & side["ok"][pos % A]
+                             & (idx < A)).astype(jnp.int32))
+        out = {
+            "key": side["key"].at[idx].set(key, mode="drop"),
+            "ts": side["ts"].at[idx].set(ets, mode="drop"),
+            "id": side["id"].at[idx].set(batch.id, mode="drop"),
+            "ok": side["ok"].at[idx].set(True, mode="drop"),
+            "pay": jax.tree.map(lambda t, v: t.at[idx].set(v, mode="drop"),
+                                side["pay"], batch.payload),
+        }
+        return out, (cur + csum[-1]) % A, overwrote
+
+    def apply(self, state, batch: Batch):
+        refs = tuple_refs(batch)
+        is_l = jax.vmap(self.side_fn)(refs).astype(jnp.bool_)
+        lmask = batch.valid & is_l
+        rmask = batch.valid & ~is_l
+        ets = self._event_ts(refs, is_l, batch)
+        wm = jnp.maximum(state["wm"],
+                         jnp.max(jnp.where(batch.valid, ets, _IMIN)))
+        # evict against the watermark AS OF THE START of the batch: this
+        # batch's own probes may carry timestamps below the post-batch
+        # watermark, and the lateness contract only promises future arrivals
+        # stay >= (previous wm) - delay
+        horizon = state["wm"] - self.delay
+        l, r = dict(state["l"]), dict(state["r"])
+        # watermark eviction: exactly the tuples no future arrival can match
+        l["ok"] = l["ok"] & (l["ts"] >= horizon - self.upper)
+        r["ok"] = r["ok"] & (r["ts"] >= horizon + self.lower)
+        # left probes see archived rights PLUS the batch's own rights (an
+        # in-batch pair counts once, from the left side)
+        cat = lambda a, b: jnp.concatenate([a, b], axis=0)
+        r_cand = (cat(r["key"], jnp.where(rmask, batch.key,
+                                          JOIN_KEY_SENTINEL)),
+                  cat(r["ts"], ets), cat(r["id"], batch.id),
+                  cat(r["ok"], rmask),
+                  jax.tree.map(cat, r["pay"], batch.payload))
+        l_cand = (l["key"], l["ts"], l["id"], l["ok"], l["pay"])
+        lrows = self._rows(batch, lmask, ets, r_cand, swap=False)
+        rrows = self._rows(batch, rmask, ets, l_cand, swap=True)
+        valid = cat(lrows[0], rrows[0])
+        out = Batch(key=cat(lrows[1], rrows[1]), id=cat(lrows[3], rrows[3]),
+                    ts=cat(lrows[2], rrows[2]),
+                    payload=jax.tree.map(cat, lrows[4], rrows[4]),
+                    valid=valid)
+        l, lcur, odl = self._append(l, state["lcur"], lmask, batch.key, ets,
+                                    batch)
+        r, rcur, odr = self._append(r, state["rcur"], rmask, batch.key, ets,
+                                    batch)
+        state = {"l": l, "r": r, "lcur": lcur, "rcur": rcur, "wm": wm,
+                 "match_drops": state["match_drops"] + lrows[5] + rrows[5],
+                 "arch_drops": state["arch_drops"] + odl + odr}
+        return state, out

@@ -1,0 +1,194 @@
+"""Incremental rank operators: per-key top-N and streaming distinct.
+
+The rank-query half of the Nexmark-class operator family (PAPER.md survey
+§2.4 lists rank/distinct beside joins and sessions):
+
+- :class:`TopN` keeps a bounded on-device leaderboard ``[K, N]`` per key and
+  merges every batch's candidates with the **bitonic sort networks of
+  ``ops/bitonic.py``** — one vmapped compare-exchange network per batch over
+  ``[K, pow2(N + C)]`` composite keys ``(-score, id, idx)``, so the rank
+  state update is a fixed-shape device program with a total order (score
+  desc, id asc; the unique ``idx`` lane makes the network output equal the
+  stable lexsort, the same property ``Ordering_Node`` relies on). Evicted
+  candidates are counted (``topn_evictions``).
+- :class:`Distinct` suppresses duplicates exactly once per distinct value:
+  in-batch duplicates fall to a ``segment_rank`` first-occurrence test, and
+  cross-batch duplicates probe the **JoinTable** (``ops/lookup.py``) through
+  the registry's ``join_probe`` kernel before the batch's new values upsert
+  (delay 0: a value is visible to every later batch).
+
+Both states are plain pytrees — checkpoint/restore + supervised replay carry
+them unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
+from ..batch import Batch, CTRL_DTYPE, TupleRef, tuple_refs
+from ..ops.bitonic import sort_network
+from ..ops.lookup import join_table_init, join_table_probe, join_table_upsert
+from ..ops.segment import segment_rank
+from .base import Basic_Operator
+
+#: empty-slot score: sorts after every real candidate under the negated
+#: composite key (user scores must be > INT32_MIN + 1)
+TOPN_SENTINEL = -(1 << 31) + 1
+
+
+def _ref_spec(payload_spec):
+    s = jax.ShapeDtypeStruct((), CTRL_DTYPE)
+    return TupleRef(key=s, id=s, ts=s, data=payload_spec)
+
+
+class TopN(Basic_Operator):
+    """Incremental per-key top-N by an i32 score.
+
+    ``score_fn(t) -> i32`` (must be > INT32_MIN + 1). Every apply emits the
+    UPDATED leaderboard rows of the keys the batch touched (``[K * N]``
+    lanes: key = key slot, id = the ranked tuple's id, payload
+    ``{"score", "rank"}``); ``flush`` emits the final leaderboard for every
+    key. Ties break deterministically by tuple id (earlier wins)."""
+
+    routing = routing_modes_t.KEYBY
+
+    def __init__(self, score_fn: Callable, n: int, *,
+                 num_keys: int = DEFAULT_MAX_KEYS, name: str = "topn",
+                 parallelism: int = 1):
+        super().__init__(name, parallelism)
+        self.score_fn = score_fn
+        self.n = int(n)
+        self.num_keys = int(num_keys)
+        if self.n < 1:
+            raise ValueError(f"{name}: n must be >= 1")
+        self._evict_synced = 0
+
+    def out_capacity(self, in_capacity: int) -> int:
+        return self.num_keys * self.n
+
+    def out_spec(self, payload_spec: Any) -> Any:
+        i = jax.ShapeDtypeStruct((), CTRL_DTYPE)
+        return {"score": i, "rank": i}
+
+    def init_state(self, payload_spec: Any):
+        K, N = self.num_keys, self.n
+        return {"score": jnp.full((K, N), TOPN_SENTINEL, jnp.int32),
+                "tid": jnp.zeros((K, N), jnp.int32),
+                "evict": jnp.asarray(0, jnp.int32),
+                "eos": jnp.asarray(0, jnp.int32)}
+
+    def _merge(self, state, keymat, scores, ids):
+        """Merge [K, C] candidates into the [K, N] leaderboard via one
+        vmapped bitonic sort network over the padded composite key."""
+        K, N = self.num_keys, self.n
+        cscore = jnp.where(keymat, scores[None, :], TOPN_SENTINEL)
+        cid = jnp.where(keymat, ids[None, :], 0)
+        alls = jnp.concatenate([state["score"], cscore], axis=1)
+        alli = jnp.concatenate([state["tid"], cid], axis=1)
+        L = 1 << max(1, (alls.shape[1] - 1).bit_length())
+        pad = L - alls.shape[1]
+        alls = jnp.pad(alls, ((0, 0), (0, pad)),
+                       constant_values=TOPN_SENTINEL)
+        alli = jnp.pad(alli, ((0, 0), (0, pad)))
+        zero = jnp.zeros((L,), jnp.int32)
+        iota = jnp.arange(L, dtype=jnp.int32)
+        neg, sid, _, _ = jax.vmap(
+            lambda p, s: sort_network(p, s, zero, iota))(-alls, alli)
+        return -neg[:, :N], sid[:, :N]
+
+    def apply(self, state, batch: Batch):
+        K, N = self.num_keys, self.n
+        refs = tuple_refs(batch)
+        scores = jax.vmap(self.score_fn)(refs).astype(jnp.int32)
+        keymat = ((batch.key[None, :]
+                   == jnp.arange(K, dtype=jnp.int32)[:, None])
+                  & batch.valid[None, :])                      # [K, C]
+        filled = jnp.sum((state["score"] != TOPN_SENTINEL).astype(jnp.int32),
+                         axis=1)
+        cands = jnp.sum(keymat.astype(jnp.int32), axis=1)
+        new_score, new_tid = self._merge(state, keymat, scores, batch.id)
+        kept = jnp.sum((new_score != TOPN_SENTINEL).astype(jnp.int32),
+                       axis=1)
+        evict = state["evict"] + jnp.sum(filled + cands - kept)
+        touched = jnp.any(keymat, axis=1)
+        state = {"score": new_score, "tid": new_tid, "evict": evict,
+                 "eos": state["eos"]}
+        return state, self._rows(state, touched)
+
+    def _rows(self, state, keep_key):
+        K, N = self.num_keys, self.n
+        flat = lambda a: a.reshape(K * N)
+        keyv = jnp.repeat(jnp.arange(K, dtype=jnp.int32), N)
+        rank = jnp.tile(jnp.arange(N, dtype=jnp.int32), K)
+        score = flat(state["score"])
+        valid = flat(keep_key[:, None]
+                     & (state["score"] != TOPN_SENTINEL))
+        return Batch(key=keyv, id=flat(state["tid"]),
+                     ts=jnp.zeros((K * N,), jnp.int32),
+                     payload={"score": score, "rank": rank}, valid=valid)
+
+    def flush(self, state):
+        import numpy as np
+        if state is None or int(np.asarray(state["eos"])):
+            return state, None
+        state = dict(state)
+        state["eos"] = jnp.asarray(1, jnp.int32)
+        self.collect_stats(state)
+        return state, self._rows(state, jnp.ones((self.num_keys,),
+                                                 jnp.bool_))
+
+    def collect_stats(self, state: Any = None) -> None:
+        if state is None:
+            return
+        import numpy as np
+        from ..control import _state as _cstate
+        ev = int(np.asarray(state["evict"]))
+        if ev > self._evict_synced:
+            _cstate.bump("topn_evictions", ev - self._evict_synced)
+            self._evict_synced = ev
+
+
+class Distinct(Basic_Operator):
+    """Pass each distinct value through exactly once.
+
+    ``value_fn(t) -> i32`` extracts the distinctness key (default: the
+    tuple's key slot; values must be > INT32_MIN). In-batch duplicates keep
+    the first occurrence in ``(key, stream-position)`` order
+    (``segment_rank``); cross-batch duplicates are suppressed by probing the
+    JoinTable *before* the batch's new values upsert. ``num_slots`` bounds
+    the distinct cardinality — overflow values are dropped from the table
+    (counted in ``state["dropped"]``) and would re-emit; size it to the
+    domain."""
+
+    routing = routing_modes_t.KEYBY
+
+    def __init__(self, value_fn: Optional[Callable] = None, *,
+                 num_slots: int = DEFAULT_MAX_KEYS, name: str = "distinct",
+                 parallelism: int = 1):
+        super().__init__(name, parallelism)
+        self.value_fn = value_fn or (lambda t: t.key)
+        self.num_slots = int(num_slots)
+        self._pending = None
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        self._pending = int(batch_capacity)
+
+    def init_state(self, payload_spec: Any):
+        pending = self._pending or self.num_slots
+        return join_table_init(self.num_slots, pending,
+                               {"one": jax.ShapeDtypeStruct((), jnp.int32)})
+
+    def apply(self, state, batch: Batch):
+        refs = tuple_refs(batch)
+        dk = jax.vmap(self.value_fn)(refs).astype(jnp.int32)
+        firsts = batch.valid & (segment_rank(dk, batch.valid) == 0)
+        _, hit = join_table_probe(state, dk, firsts)
+        keep = firsts & ~hit
+        ones = jnp.ones((batch.capacity,), jnp.int32)
+        state = join_table_upsert(state, dk, {"one": ones}, batch.ts,
+                                  batch.id, keep, delay=0)
+        return state, batch.mask(keep)

@@ -41,10 +41,34 @@ class WindowSpec:
     def __post_init__(self):
         if self.win_len <= 0 or self.slide <= 0:
             raise ValueError("win_len and slide must be positive")
+        if self.delay < 0:
+            raise ValueError("delay (lateness) must be >= 0")
+
+    @staticmethod
+    def session(gap: int, delay: int = 0) -> "WindowSpec":
+        """A data-dependent-gap session window: a per-key session stays open
+        while consecutive events arrive within ``gap`` time units of each
+        other and closes once the gap is exceeded. Unlike the CB/TB
+        triggerers — whose firing lattice is fixed by (win_len, slide) — the
+        session firing bound is a *function of the observed inter-arrival
+        gaps* (:meth:`fired_session`). ``delay`` is the usual TB-style
+        lateness allowance. Consumed by
+        :class:`~windflow_tpu.operators.session.SessionWindow`."""
+        return WindowSpec(int(gap), int(gap), win_type_t.SESSION, int(delay))
 
     @property
     def is_cb(self):
         return self.wtype == win_type_t.CB
+
+    @property
+    def is_session(self):
+        return self.wtype == win_type_t.SESSION
+
+    @property
+    def gap(self) -> int:
+        """Session inter-arrival gap (win_len doubles as the gap — a session
+        is a window whose length grows with its content)."""
+        return self.win_len
 
     # batch-level triggerer arithmetic ------------------------------------------------
 
@@ -62,6 +86,17 @@ class WindowSpec:
 
     def flush_hi_tb(self, max_ts, has_any):
         return jnp.where(has_any, max_ts // self.slide + 1, 0)
+
+    def fired_session(self, last_ts, watermark):
+        """SESSION triggerer: whether a session whose newest event is
+        ``last_ts`` is FIRED under ``watermark`` (max ts seen). The firing
+        bound is data-dependent — it moves with every arrival, so unlike
+        :meth:`fired_hi_tb` there is no static window-id lattice: the session
+        closes exactly when no event within ``gap`` of its newest member can
+        still arrive, i.e. ``watermark - delay > last_ts + gap``. Batched
+        and masked like the TB path (callers evaluate it over the whole
+        ``[K]`` open-session table in one fixed-shape program)."""
+        return watermark - self.delay > last_ts + self.gap
 
 
 @jax.tree_util.register_dataclass

@@ -240,6 +240,219 @@ def _join_probe_pallas(table_keys, table_vals, probe, valid, *,
     return vals, hit != 0
 
 
+# ------------------------------------- versioned, watermark-consistent table
+
+#: key value marking an unused table slot / an impossible probe. User join
+#: keys must be strictly inside (INT32_MIN, INT32_MAX) — INT32_MIN is this
+#: sentinel, INT32_MAX is the upsert path's sort sentinel; any non-negative
+#: key below 2^31-1 qualifies.
+JOIN_KEY_SENTINEL = -(1 << 31)
+
+
+def _scalar_leaves(val_spec):
+    leaves = jax.tree.leaves(val_spec)
+    if not leaves:
+        raise ValueError("JoinTable: value spec must have at least one leaf")
+    for leaf in leaves:
+        if tuple(getattr(leaf, "shape", ())) != ():
+            raise ValueError(
+                f"JoinTable values must be pytrees of SCALAR leaves (each "
+                f"probed through the registry's join_probe kernel as one "
+                f"[K] column); got leaf shape {getattr(leaf, 'shape', '?')}")
+    return leaves
+
+
+def join_table_init(num_slots: int, pending: int, val_spec) -> dict:
+    """State pytree of a **versioned, watermark-consistent join-state
+    table** — the HBM key table of this module grown into the join-state
+    primitive of ROADMAP item 1. Upserts are *versioned by event time*: an
+    upsert (key, val, ts, id) parks in a bounded pending ring until the
+    build-side watermark (max ts seen) passes ``ts + delay``, then applies
+    in ``(ts, id, arrival)`` order with last-writer-wins per key — so a
+    probe at watermark W reads the table state **as-of W**, deterministically
+    under any batch interleave the watermark contract allows (every tuple
+    with ts <= W - delay has arrived). The state is a plain pytree: it rides
+    the existing checkpoint/restore + exactly-once outbox paths unchanged.
+
+    ``val_spec``: pytree of scalar examples/ShapeDtypeStructs — the per-key
+    value columns (each probed via the ``join_probe`` registry kernel)."""
+    K, P = int(num_slots), int(pending)
+    if K < 1 or P < 1:
+        raise ValueError("join_table_init: num_slots and pending must be >= 1")
+    imin = jnp.iinfo(jnp.int32).min
+
+    def zcol(n):
+        return jax.tree.map(
+            lambda s: jnp.zeros((n,), getattr(s, "dtype",
+                                              jnp.result_type(s))), val_spec)
+    _scalar_leaves(val_spec)
+    return {
+        # the table proper: one row per key, latest applied version
+        "key": jnp.full((K,), JOIN_KEY_SENTINEL, jnp.int32),
+        "val": zcol(K),
+        "ver": jnp.full((K,), imin, jnp.int32),     # version event time
+        "vid": jnp.full((K,), imin, jnp.int32),     # version tuple id
+        "vseq": jnp.full((K,), imin, jnp.int32),    # version arrival seq
+        "used": jnp.zeros((K,), jnp.bool_),
+        # pending ring: upserts not yet watermark-eligible (prefix-compacted)
+        "pkey": jnp.zeros((P,), jnp.int32), "pval": zcol(P),
+        "pts": jnp.zeros((P,), jnp.int32), "pid": jnp.zeros((P,), jnp.int32),
+        "pseq": jnp.zeros((P,), jnp.int32),
+        "pok": jnp.zeros((P,), jnp.bool_),
+        "wm": jnp.asarray(imin, jnp.int32),         # build-side watermark
+        "seq": jnp.asarray(0, jnp.int32),           # arrival stamp source
+        "version": jnp.asarray(0, jnp.int32),       # applied upserts (gauge)
+        "dropped": jnp.asarray(0, jnp.int32),       # ring/table overflow drops
+    }
+
+
+def join_table_upsert(state: dict, key: jax.Array, val, ts: jax.Array,
+                      tid: jax.Array, ok: jax.Array, *,
+                      delay: int = 0) -> dict:
+    """Buffer the batch's build-side tuples and apply every upsert the
+    watermark has made eligible (``ts <= wm - delay``). Fixed-shape, fully
+    vectorized (no serial per-row loop): per-key last-writer-wins is ONE
+    lexsort of the ring by ``(key, ts, id, arrival)`` taking each key
+    group's last entry (O(P log P) — no quadratic dominance matrix), fresh
+    keys claim free slots in deterministic ``(ts, id, arrival)`` order, and
+    a late-but-eligible upsert can never roll a slot back below its applied
+    version. Duplicate-key upserts are
+    therefore last-writer-wins BY EVENT TIME (ties broken by tuple id, then
+    arrival), not by scatter luck — the determinism contract the chaos
+    suite pins. Overflowing the pending ring or a full table *drops* the
+    upsert and counts it in ``state["dropped"]``."""
+    imin = jnp.iinfo(jnp.int32).min
+    big = jnp.iinfo(jnp.int32).max
+    P = state["pkey"].shape[0]
+    K = state["key"].shape[0]
+    ok = ok.astype(jnp.bool_)
+    key = key.astype(jnp.int32)
+    ts = ts.astype(jnp.int32)
+    tid = tid.astype(jnp.int32)
+
+    # 1. append to the pending ring (prefix-compacted invariant: live entries
+    #    occupy a prefix, so the insert cursor is the live count)
+    cnt = jnp.sum(state["pok"].astype(jnp.int32))
+    csum = jnp.cumsum(ok.astype(jnp.int32))
+    pos = cnt + csum - 1
+    keep = ok & (pos < P)
+    dropped = state["dropped"] + jnp.sum((ok & ~keep).astype(jnp.int32))
+    slot = jnp.where(keep, pos, P)
+    arrive = state["seq"] + csum - 1
+    pkey = state["pkey"].at[slot].set(key, mode="drop")
+    pts = state["pts"].at[slot].set(ts, mode="drop")
+    pid = state["pid"].at[slot].set(tid, mode="drop")
+    pseq = state["pseq"].at[slot].set(arrive, mode="drop")
+    pval = jax.tree.map(lambda t, v: t.at[slot].set(v.astype(t.dtype),
+                                                    mode="drop"),
+                        state["pval"], val)
+    pok = state["pok"].at[slot].set(True, mode="drop")
+    seq = state["seq"] + jnp.sum(ok.astype(jnp.int32))
+
+    # 2. advance the build-side watermark
+    wm = jnp.maximum(state["wm"], jnp.max(jnp.where(ok, ts, imin)))
+
+    # 3. eligible entries + per-key last-writer winners over (ts, id, seq):
+    #    ONE lexsort by (key, version) and take each key group's last entry
+    #    — O(P log P), no [P, P] dominance matrix (at the operators' default
+    #    pending = 2 * batch capacity a quadratic compare would materialize
+    #    GiB-scale intermediates per step)
+    elig = pok & (pts <= wm - int(delay))
+
+    def lex_gt(a_ts, a_id, a_seq, b_ts, b_id, b_seq):
+        """(b_ts, b_id, b_seq) strictly > (a_ts, a_id, a_seq)."""
+        return ((b_ts > a_ts)
+                | ((b_ts == a_ts) & (b_id > a_id))
+                | ((b_ts == a_ts) & (b_id == a_id) & (b_seq > a_seq)))
+
+    keysort = jnp.where(elig, pkey, big)        # ineligible sort to the end
+    vperm = jnp.lexsort((pseq, pid, pts, keysort))
+    sk_sorted = keysort[vperm]
+    nxt_key = jnp.concatenate([sk_sorted[1:],
+                               jnp.full((1,), big, sk_sorted.dtype)])
+    # last entry of an eligible key group = that key's max (ts, id, seq);
+    # works because ineligible entries (key big) sort strictly after (user
+    # keys are < INT32_MAX — the sentinel contract)
+    win_sorted = (sk_sorted != big) & (sk_sorted != nxt_key)
+    win = jnp.zeros((P,), jnp.bool_).at[vperm].set(win_sorted)
+
+    # 4. slot resolution: existing row wins, else the r-th fresh key (in
+    #    (ts, id, seq) order) claims the r-th free slot (ascending slot index)
+    used = state["used"]
+    eq = (state["key"][None, :] == pkey[:, None]) & used[None, :]    # [P, K]
+    has_slot = jnp.any(eq, axis=1)
+    slot_old = jnp.argmax(eq, axis=1)
+    need_new = win & ~has_slot
+    order = jnp.lexsort((pseq, pid, jnp.where(need_new, pts, big)))
+    rnk = jnp.zeros((P,), jnp.int32).at[order].set(
+        jnp.arange(P, dtype=jnp.int32))
+    free = ~used
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1               # [K]
+    oh = free[None, :] & (free_rank[None, :] == rnk[:, None])        # [P, K]
+    got_new = jnp.any(oh, axis=1)
+    slot_new = jnp.argmax(oh, axis=1)
+    dropped = dropped + jnp.sum((need_new & ~got_new).astype(jnp.int32))
+
+    # 5. never roll back: the pending version must beat the slot's applied one
+    beats = lex_gt(state["ver"][slot_old], state["vid"][slot_old],
+                   state["vseq"][slot_old], pts, pid, pseq)
+    write = win & jnp.where(has_slot, beats, got_new)
+    widx = jnp.where(write, jnp.where(has_slot, slot_old, slot_new), K)
+    out = dict(state)
+    out["key"] = state["key"].at[widx].set(pkey, mode="drop")
+    out["ver"] = state["ver"].at[widx].set(pts, mode="drop")
+    out["vid"] = state["vid"].at[widx].set(pid, mode="drop")
+    out["vseq"] = state["vseq"].at[widx].set(pseq, mode="drop")
+    out["used"] = used.at[widx].set(True, mode="drop")
+    out["val"] = jax.tree.map(lambda t, v: t.at[widx].set(v, mode="drop"),
+                              state["val"], pval)
+    out["version"] = state["version"] + jnp.sum(write.astype(jnp.int32))
+
+    # 6. every eligible entry leaves the ring; recompact survivors (stable)
+    pok2 = pok & ~elig
+    order2 = jnp.argsort(jnp.where(pok2, 0, 1), stable=True)
+    take = lambda a: jnp.take(a, order2, axis=0)
+    out["pkey"], out["pts"], out["pid"], out["pseq"] = (
+        take(pkey), take(pts), take(pid), take(pseq))
+    out["pval"] = jax.tree.map(take, pval)
+    out["pok"] = take(pok2)
+    out["wm"], out["seq"], out["dropped"] = wm, seq, dropped
+    return out
+
+
+def join_table_probe(state: dict, key: jax.Array, ok: jax.Array, *,
+                     impl: str = None):
+    """Probe the applied (watermark-visible) table state: returns
+    ``(vals pytree[C], hit bool[C])``. Every value column resolves through
+    the kernel registry's ``join_probe`` (``xla`` select-reduce reference /
+    fused Pallas one-hot — byte-identical under the unique-key invariant the
+    table maintains by construction). Oversize tables (``K >`` the Pallas
+    ``JOIN_PROBE_MAX_ROWS`` envelope) route to the XLA reference *inside*
+    :func:`join_probe` — selection is an optimization, never an error."""
+    tk = jnp.where(state["used"], state["key"], JOIN_KEY_SENTINEL)
+    key = key.astype(jnp.int32)
+    leaves, treedef = jax.tree.flatten(state["val"])
+    if len(leaves) == 1:
+        v, hit = join_probe(tk, leaves[0], key, ok, impl=impl)
+        return jax.tree.unflatten(treedef, [v]), hit
+    # multi-column values: run the [C, K] contraction ONCE, probing for the
+    # slot index, then gather every column — same registry-resolved kernel,
+    # one probe regardless of column count (a gather per column beats a
+    # full contraction per column)
+    K = tk.shape[0]
+    slot, hit = join_probe(tk, jnp.arange(K, dtype=jnp.int32), key, ok,
+                           impl=impl)
+    vals = [jnp.where(hit, jnp.take(tv, slot, axis=0),
+                      jnp.zeros((), tv.dtype)) for tv in leaves]
+    return jax.tree.unflatten(treedef, vals), hit
+
+
+def join_table_pending(state: dict) -> jax.Array:
+    """Live pending-ring entries (traced scalar) — upserts parked behind the
+    watermark."""
+    return jnp.sum(state["pok"].astype(jnp.int32))
+
+
 def _factored_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     """Row-select by one-hot matmul over K1, column-select on the VPU over K2."""
     import math

@@ -221,6 +221,24 @@ class MultiPipe:
         self.graph._merged_roots.append(merged)
         return merged
 
+    def join_with(self, other: "MultiPipe", join_op) -> "MultiPipe":
+        """Two-input join wiring over merge semantics: merge this pipe with
+        ``other`` (the ``wf/pipegraph.hpp:1573-1578`` typeid check applies —
+        both sides must already carry the unified/tagged payload schema) and
+        add ``join_op`` (a :class:`~windflow_tpu.operators.join.
+        StreamTableJoin` / :class:`~windflow_tpu.operators.join.
+        IntervalJoin`, whose ``side_fn`` separates the sides again). Under
+        ``Mode.DETERMINISTIC`` the merge's Ordering_Node fixes the
+        interleave, making the join byte-identical across drivers."""
+        from ..operators.join import IntervalJoin, StreamTableJoin
+        if not isinstance(join_op, (StreamTableJoin, IntervalJoin)):
+            raise TypeError(
+                f"join_with expects a StreamTableJoin/IntervalJoin operator, "
+                f"got {type(join_op).__name__}")
+        merged = self.merge(other)
+        merged.add(join_op)
+        return merged
+
     # -- internals --------------------------------------------------------------------
 
     def _check_open(self):
