@@ -253,7 +253,8 @@ def bench_ysb():
     src = ysb.make_source(total=(STEPS + 2) * BATCH)
     ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
                        max_wins=panes_per_batch + 64)
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH,
+                          event_time=False)
 
     step, specs = _cursor_bench(chain, src)
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS)
@@ -296,7 +297,8 @@ def bench_ysb_wmr(map_parallelism: int = 4):
                            max_wins=ysb.N_CAMPAIGNS * (wins_per_batch + 2),
                            tb_capacity=8192)
     ops.append(ReduceSink(lambda t: t.data, name="wmr_total"))
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH,
+                          event_time=False)
 
     step, specs = _cursor_bench(chain, src)
     dt, states = _bench_loop(step, tuple(chain.states), STEPS)
@@ -339,12 +341,49 @@ def bench_nexmark(batch: int = None, steps: int = None):
     rows = {}
     for name in QUERIES:
         src, ops = make_query(name, total)
-        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
+        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch,
+                              event_time=False)
         step = device_cursor_step(chain, src, batch)
         dt, _ = _bench_loop(step, tuple(chain.states), steps)
         rows[name] = {"tps": steps * batch / dt, "step_s": dt / steps,
                       "batch": batch}
+        # e2e event-time p99 per query: a SHORT separate pass with the
+        # event-time histograms compiled in (the timed row above stays the
+        # exact monitoring-off program) — the max per-(operator, stream)
+        # observed-lateness p99, in event-time ticks.  bench_trend.py
+        # renders the column beside the per-query throughput.
+        rows[name]["event_time_p99"] = _nexmark_event_time_p99(
+            name, total, batch, min(steps, 5))
     return rows
+
+
+def _nexmark_event_time_p99(name, total, batch, steps):
+    """Max observed-lateness p99 (ticks) across one query's stateful
+    operators after ``steps`` batches with event-time monitoring compiled
+    in; None when the query has no lateness surface."""
+    from windflow_tpu.benchmarks import device_cursor_step
+    from windflow_tpu.nexmark import make_query
+    from windflow_tpu.runtime.pipeline import CompiledChain
+    src, ops = make_query(name, total)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch,
+                          event_time=True)
+    step = device_cursor_step(chain, src, batch)
+    states = tuple(chain.states)
+    import jax.numpy as jnp
+    cur = jnp.asarray(0, jnp.int32)
+    for _ in range(int(steps)):
+        states, cur, _out = step(states, cur)
+    chain.states = list(states)
+    p99 = None
+    for op, st in zip(chain.ops, chain.states):
+        try:
+            sec = op.event_time_stats(st)
+        except Exception:   # noqa: BLE001 — bench telemetry is advisory
+            continue
+        for summ in ((sec or {}).get("lateness") or {}).values():
+            if summ.get("total"):
+                p99 = max(p99 or 0, summ["p99"])
+    return p99
 
 
 def bench_stateless():
@@ -362,7 +401,8 @@ def bench_stateless():
     ops = [Map(lambda t: {"v": t.v * 2.0 + 1.0}),
            Filter(lambda t: t.v > 100.0),
            ReduceSink(lambda t: t.v)]
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH,
+                          event_time=False)
 
     step, specs = _cursor_bench(chain, src)
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS)
@@ -385,7 +425,8 @@ def bench_keyed_cb():
                        total=(reps * STEPS + 2) * BATCH, num_keys=K)
     op = Key_FFAT(lambda t: t.v, jnp.add,
                   spec=WindowSpec(1024, 512), num_keys=K)
-    chain = CompiledChain([op], src.payload_spec(), batch_capacity=BATCH)
+    chain = CompiledChain([op], src.payload_spec(), batch_capacity=BATCH,
+                          event_time=False)
 
     step, specs = _cursor_bench(chain, src)
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS, reps=reps)
@@ -448,7 +489,8 @@ def bench_latency_curve(batches=(4096, 16384, 65536, 262144), steps: int = 80,
         src = ysb.make_source(total=(steps + 4) * batch)
         ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
                            max_wins=panes_per_batch + 64)
-        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
+        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch,
+                              event_time=False)
 
         # device-resident cursor, advanced in-program: a per-step host-scalar
         # upload would sit INSIDE every latency sample (RTT-class through the
@@ -593,7 +635,8 @@ def bench_keyed_stateful(num_keys: int):
     ops = [Accumulator(lambda t: t.data["v"], init_value=0.0,
                        num_keys=max(num_keys, 8)),
            ReduceSink(lambda t: t.data)]
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=BATCH,
+                          event_time=False)
 
     step, _ = _cursor_bench(chain, src)
     dt, _ = _bench_loop(step, tuple(chain.states), STEPS, reps=reps)
@@ -729,7 +772,8 @@ def bench_ingest():
     panes_per_batch = B // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN) + 1
     ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
                        max_wins=panes_per_batch + 64)
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=B)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=B,
+                          event_time=False)
 
     # warmup/compile on the first chunk
     warm = next(iter(src.batches(B)))
@@ -885,7 +929,8 @@ def bench_drive_loop(batches=(4096, 262144, 1 << 20),
                            total=(n2 + 2) * B, num_keys=8)
         ops = [wf.Map(lambda t: {"v": t.v * 2.0 + 1.0}),
                wf.ReduceSink(lambda t: t.v, name="out")]
-        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=B)
+        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=B,
+                              event_time=False)
 
         # bare loop carries a DEVICE cursor exactly like the driven path
         # (operators/source.py::batches) — if it uploaded a host int per step
@@ -1178,10 +1223,17 @@ def _secondary_benches(ysb_tps, ysb_step_s, headline=None):
     record("nexmark", nx, methodology="isolated-subprocess")
     if headline is not None:
         headline["nexmark"] = {q: round(r["tps"], 1) for q, r in nx.items()}
+        # e2e event-time p99 per query (ticks) — the bench_trend.py
+        # event-time column; queries without a lateness surface omit
+        headline["nexmark_event_time"] = {
+            q: r["event_time_p99"] for q, r in nx.items()
+            if r.get("event_time_p99") is not None}
         record_headline(headline)
     for q, r in sorted(nx.items()):
+        et = (f", et-p99={r['event_time_p99']}"
+              if r.get("event_time_p99") is not None else "")
         print(f"nexmark {q}: {r['tps']/1e6:.2f} M tuples/s "
-              f"({r['step_s']*1e3:.2f} ms/step, batch={r['batch']})",
+              f"({r['step_s']*1e3:.2f} ms/step, batch={r['batch']}{et})",
               file=sys.stderr)
     kc_tps, kc_step, kc_roof, kc_metrics = _run_isolated("bench_keyed_cb()")
     record("keyed_cb", {"tps": kc_tps, "step_s": kc_step, "roofline": kc_roof,

@@ -121,7 +121,9 @@ def bench_rows(rounds, threshold: float):
 
 def nexmark_rows(rounds):
     """Per-round Nexmark query throughput (the bench.py headline ``nexmark``
-    record: ``{query: tps}``). Rounds predating the suite render as '—';
+    record: ``{query: tps}``) plus the e2e event-time p99 record
+    (``nexmark_event_time``: ``{query: lateness p99 ticks}``, rounds with
+    event-time observability). Rounds predating the suite render as '—';
     failed rounds surface the same way the main table does."""
     queries, rows = [], []
     for n, d in rounds:
@@ -133,7 +135,9 @@ def nexmark_rows(rounds):
     for n, d in rounds:
         parsed = d.get("parsed")
         nx = (parsed or {}).get("nexmark")
+        et = (parsed or {}).get("nexmark_event_time")
         row = {"round": n, "tps": nx if isinstance(nx, dict) else None,
+               "event_time": et if isinstance(et, dict) else None,
                "status": "ok" if isinstance(nx, dict) else
                ("FAILED" if parsed is None or d.get("rc") not in (0, None)
                 else "—")}
@@ -190,6 +194,21 @@ def render_nexmark(queries, rows) -> list:
                          else "—")
         lines.append(f"| r{r['round']:02d} | {r['status']} | "
                      + " | ".join(cells) + " |")
+    if any(r["event_time"] for r in rows):
+        # e2e event-time p99 per query (ticks): the observed-lateness
+        # quantile of each query's stateful operators — the delay-tuning
+        # signal next to the throughput it buys
+        lines += ["", "### event-time p99 per query "
+                      "(`parsed.nexmark_event_time`, ticks)", ""]
+        lines.append("| round | " + " | ".join(queries) + " |")
+        lines.append("|---|" + "---|" * len(queries))
+        for r in rows:
+            if not r["event_time"]:
+                continue
+            cells = [(_fmt(r["event_time"].get(q))
+                      if r["event_time"].get(q) is not None else "—")
+                     for q in queries]
+            lines.append(f"| r{r['round']:02d} | " + " | ".join(cells) + " |")
     return lines
 
 

@@ -1,0 +1,287 @@
+#!/usr/bin/env python3
+"""wf_state — stateful-operator / event-time inspection CLI.
+
+Reads a monitoring run's artifacts (``snapshots.jsonl`` time series +
+``snapshot.json`` + ``events.jsonl``) and renders:
+
+- the **watermark propagation map**: per-operator event-time frontiers, the
+  graph-level min-watermark frontier (who is holding event time back), and
+  per-edge watermark skew;
+- **state-pressure trends**: table occupancy / pending-ring depth / archive
+  fill / open sessions over the run, with overflow-risk flags;
+- the **lateness report**: per-(operator, stream) observed-lateness
+  histograms with quantiles and ``recommend_delay(q)`` — the smallest
+  ``delay=`` covering quantile ``q`` of the observed lateness — joined with
+  the operator's drop counters and any ``lateness_drop`` journal events.
+
+Produce the inputs with event-time monitoring on::
+
+    WF_MONITORING=1 WF_MONITORING_EVENT_TIME=1 python my_run.py
+    python scripts/wf_state.py --monitoring-dir wf_monitoring
+
+Stdlib only (``observability/event_time.py`` is loaded by file path — the
+``wf_trace.py`` convention), so this works on any box the artifacts were
+copied to, without JAX installed.
+
+Exit codes: 0 = report rendered, 2 = missing/unreadable inputs or usage
+error (``tests/test_event_time.py`` pins the contract).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_event_time():
+    """Load observability/event_time.py by file path — no package import,
+    no JAX (the module keeps its jax imports inside the device helpers)."""
+    path = os.path.join(REPO, "windflow_tpu", "observability",
+                        "event_time.py")
+    spec = importlib.util.spec_from_file_location("wf_event_time", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["wf_event_time"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_snapshots(mon_dir):
+    """(latest snapshot, full time series) from a monitoring directory."""
+    series = []
+    jl = os.path.join(mon_dir, "snapshots.jsonl")
+    if os.path.exists(jl):
+        with open(jl) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    series.append(json.loads(line))
+    latest = None
+    sj = os.path.join(mon_dir, "snapshot.json")
+    if os.path.exists(sj):
+        with open(sj) as f:
+            latest = json.load(f)
+    elif series:
+        latest = series[-1]
+    if latest is None:
+        raise FileNotFoundError(
+            f"no snapshot.json / snapshots.jsonl under {mon_dir!r}")
+    return latest, series
+
+
+def _load_journal(mon_dir):
+    path = os.path.join(mon_dir, "events.jsonl")
+    out = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+# ------------------------------------------------------------ report pieces
+
+#: occupancy-style percentages above this flag OVERFLOW-RISK in the
+#: pressure report (state tables drop, not grow, when full)
+RISK_PCT = 80.0
+
+
+def _et_rows(snap):
+    """(name, event_time section) for every operator carrying one."""
+    return [(r["name"], r["event_time"]) for r in snap.get("operators", [])
+            if r.get("event_time")]
+
+
+def watermark_map(snap):
+    lines = ["== watermark propagation map =="]
+    rows = _et_rows(snap)
+    if not rows:
+        lines.append("  (no event_time sections — run with "
+                     "WF_MONITORING_EVENT_TIME=1 / "
+                     "MonitoringConfig(event_time=True))")
+        return lines
+    for name, sec in rows:
+        bits = []
+        if "watermark_ts" in sec:
+            bits.append(f"wm={sec['watermark_ts']}")
+        if "fire_frontier_ts" in sec:
+            bits.append(f"frontier={sec['fire_frontier_ts']}")
+        if "lag" in sec:
+            bits.append(f"lag={sec['lag']}")
+        if "applied_version" in sec:
+            bits.append(f"version={sec['applied_version']}")
+        if "delay" in sec:
+            bits.append(f"delay={sec['delay']}")
+        detail = "  ".join(bits) if bits else "(no event-time frontier)"
+        lines.append(f"  {name:<28} {detail}")
+    et = snap.get("event_time") or {}
+    if "min_watermark_ts" in et:
+        who = et.get("frontier_operator")
+        lines.append(f"  graph min-watermark frontier: "
+                     f"{et['min_watermark_ts']}"
+                     + (f" (held by {who})" if who else ""))
+    for edge, skew in sorted((et.get("edge_skew_ts") or {}).items()):
+        lines.append(f"  edge {edge:<24} watermark skew {skew:+d}")
+    return lines
+
+
+#: (section key, display label) pairs of the pressure gauges we trend
+_PRESSURE_KEYS = (
+    ("occupancy_pct", "occupancy%"),
+    ("pending_depth", "pending"),
+    ("l_fill_pct", "l-archive%"),
+    ("r_fill_pct", "r-archive%"),
+    ("open_sessions", "open-sessions"),
+)
+
+
+def pressure_trends(snap, series):
+    lines = ["== state-pressure trends =="]
+    hist = {}                       # (op, key) -> [values over time]
+    for s in series or [snap]:
+        for name, sec in _et_rows(s):
+            for key, _label in _PRESSURE_KEYS:
+                if key in sec:
+                    hist.setdefault((name, key), []).append(sec[key])
+    if not hist:
+        lines.append("  (no pressure gauges in the snapshots)")
+        return lines
+    for name, sec in _et_rows(snap):
+        for key, label in _PRESSURE_KEYS:
+            if key not in sec:
+                continue
+            vals = hist.get((name, key), [sec[key]])
+            flag = ""
+            if key.endswith("pct") and max(vals) >= RISK_PCT:
+                flag = "  [OVERFLOW-RISK]"
+            if (key == "pending_depth" and sec.get("pending_capacity")
+                    and max(vals) >= RISK_PCT / 100.0
+                    * sec["pending_capacity"]):
+                flag = "  [OVERFLOW-RISK]"
+            lines.append(f"  {name:<28} {label:<14} "
+                         f"first={vals[0]} last={vals[-1]} "
+                         f"max={max(vals)}{flag}")
+        drops = {k: v for k, v in sec.items()
+                 if k.endswith("_drops") and v}
+        if drops:
+            lines.append(f"  {name:<28} drops          "
+                         + "  ".join(f"{k}={v}" for k, v in
+                                     sorted(drops.items())))
+    return lines
+
+
+def lateness_report(snap, journal, et, q):
+    lines = [f"== lateness report (recommend_delay at q={q}) =="]
+    data = {}
+    any_hist = False
+    for name, sec in _et_rows(snap):
+        for stream, summ in (sec.get("lateness") or {}).items():
+            any_hist = True
+            counts = summ.get("counts") or []
+            rec = et.recommend_delay(counts, q)
+            cur = sec.get("delay")
+            verdict = ""
+            if cur is not None:
+                verdict = (" — current delay covers it" if cur >= rec
+                           else f" — RAISE delay from {cur}")
+            lines.append(
+                f"  {name:<28} stream={stream:<6} samples={summ.get('total')}"
+                f" p50={summ.get('p50')} p95={summ.get('p95')}"
+                f" p99={summ.get('p99')} max={summ.get('max')}"
+                f"  recommend_delay={rec}{verdict}")
+            data[f"{name}/{stream}"] = {
+                "recommend_delay": rec, "current_delay": cur,
+                "total": summ.get("total"), "p50": summ.get("p50"),
+                "p95": summ.get("p95"), "p99": summ.get("p99"),
+                "max": summ.get("max")}
+    if not any_hist:
+        lines.append("  (no lateness histograms recorded)")
+    drops = [e for e in journal if e.get("event") == "lateness_drop"]
+    if drops:
+        lines.append("  drop journal:")
+        for e in drops:
+            coord = (f" at/before pos={e['pos']}"
+                     if e.get("pos") is not None else "")
+            lines.append(f"    {e.get('op', '?'):<26} {e.get('kind', '?'):<16}"
+                         f" +{e.get('n', 0)} (total {e.get('total', '?')})"
+                         f"{coord}")
+    return lines, data
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_state",
+        description="windflow_tpu state-inspector / event-time CLI")
+    ap.add_argument("--monitoring-dir", default="wf_monitoring",
+                    help="monitoring output directory (snapshots.jsonl + "
+                         "snapshot.json + events.jsonl)")
+    ap.add_argument("--q", type=float, default=0.99,
+                    help="lateness quantile recommend_delay must cover "
+                         "(default 0.99; 1.0 = every recorded straggler)")
+    ap.add_argument("--report", choices=("all", "watermarks", "pressure",
+                                         "lateness"), default="all",
+                    help="which section(s) to render (default all)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: the latest snapshot's "
+                         "event_time sections + per-stream delay "
+                         "recommendations")
+    args = ap.parse_args(argv)
+
+    if not (0.0 < args.q <= 1.0):
+        print(f"wf_state: --q must be in (0, 1], got {args.q}",
+              file=sys.stderr)
+        return 2
+    try:
+        et = _load_event_time()
+    except (OSError, ImportError, SyntaxError) as e:
+        # the 0/2 contract covers the bucket-math module too: a box the
+        # artifacts were copied to without the windflow_tpu tree beside
+        # this script gets the guidance, not a traceback
+        print(f"wf_state: cannot load observability/event_time.py from "
+              f"{REPO!r}: {type(e).__name__}: {e}\n"
+              f"(keep scripts/wf_state.py next to its windflow_tpu tree — "
+              f"it reuses the lateness bucket math by file path)",
+              file=sys.stderr)
+        return 2
+    try:
+        snap, series = _load_snapshots(args.monitoring_dir)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"wf_state: cannot load snapshots from "
+              f"{args.monitoring_dir!r}: {type(e).__name__}: {e}\n"
+              f"(run with WF_MONITORING=1 WF_MONITORING_EVENT_TIME=1, or "
+              f"monitoring=MonitoringConfig(event_time=True))",
+              file=sys.stderr)
+        return 2
+    journal = _load_journal(args.monitoring_dir)
+
+    lat_lines, lat_data = lateness_report(snap, journal, et, args.q)
+    if args.json:
+        out = {"graph": snap.get("graph"),
+               "event_time": snap.get("event_time") or {},
+               "operators": {name: sec for name, sec in _et_rows(snap)},
+               "recommendations": lat_data,
+               "snapshots": len(series)}
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0
+    blocks = []
+    if args.report in ("all", "watermarks"):
+        blocks.append(watermark_map(snap))
+    if args.report in ("all", "pressure"):
+        blocks.append(pressure_trends(snap, series))
+    if args.report in ("all", "lateness"):
+        blocks.append(lat_lines)
+    print(f"wf_state: {args.monitoring_dir!r} — graph "
+          f"{snap.get('graph', '?')!r}, {len(series)} snapshot(s), "
+          f"{len(journal)} journal event(s)")
+    for b in blocks:
+        print()
+        print("\n".join(b))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

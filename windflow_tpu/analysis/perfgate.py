@@ -74,7 +74,8 @@ def _build_ysb():
     src = ysb.make_source(total=16 * cap)
     ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
                        max_wins=panes_per_batch + 64)
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap,
+                          event_time=False)
     step = device_cursor_step(chain, src, cap)
     return chain, step, cap
 
@@ -100,7 +101,8 @@ def _build_mp_matrix():
            Filter(lambda t: t.v > 2.0),
            Key_FFAT(lambda t: t.v, jnp.add,
                     spec=WindowSpec(40, 20, win_type_t.TB), num_keys=8)]
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap,
+                          event_time=False)
     step = device_cursor_step(chain, src, cap)
     return chain, step, cap
 
@@ -114,7 +116,8 @@ def _build_nexmark(query: str, cap: int):
     from ..runtime.pipeline import CompiledChain
     from ..benchmarks import device_cursor_step
     src, ops = make_query(query, total=16 * cap)
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap,
+                          event_time=False)
     step = device_cursor_step(chain, src, cap)
     return chain, step, cap
 
@@ -360,7 +363,8 @@ def _dispatch_chain(k: int, capacity: int):
                  total=k * capacity, num_keys=8)
     ops = [Map(lambda t: {"v": t.v * 2.0 + 1.0}),
            Filter(lambda t: t.v > 3.0)]
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=capacity)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=capacity,
+                          event_time=False)
     return chain, list(src.batches(capacity))
 
 

@@ -40,7 +40,8 @@ def _chain_step(ops, src, batch):
     from . import device_cursor_step
     from ..runtime.pipeline import CompiledChain
 
-    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch)
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch,
+                          event_time=False)
     return device_cursor_step(chain, src, batch), tuple(chain.states)
 
 

@@ -23,6 +23,9 @@ enabled per graph/pipeline via ``PipeGraph(..., monitoring=...)`` /
     WF_MONITORING=1              # defaults: ./wf_monitoring, 1 s interval
     WF_MONITORING=/path/out      # same, custom output directory
     WF_MONITORING_INTERVAL=0.25  # reporter interval override (seconds)
+    WF_MONITORING_EVENT_TIME=1   # event-time sub-toggle (watermark map +
+                                 # on-device lateness histograms; see
+                                 # MonitoringConfig.event_time)
 """
 
 from __future__ import annotations
@@ -33,6 +36,7 @@ from typing import Optional, Union
 
 from .journal import EventJournal, read_journal, set_active as set_journal
 from .metrics import LogHistogram, MetricsRegistry
+from . import event_time
 from .names import (CONTROL_COUNTERS, CONTROL_GAUGES, JOURNAL_EVENTS,
                     RECOVERY_COUNTERS, TRACE_RECORD_KINDS, TRACE_STAGES)
 from .reporter import Reporter
@@ -45,7 +49,7 @@ from . import journal, tracing
 __all__ = [
     "LogHistogram", "MetricsRegistry", "Reporter", "EventJournal",
     "MonitoringConfig", "Monitor", "journal", "read_journal", "set_journal",
-    "TraceConfig", "Tracer", "tracing",
+    "TraceConfig", "Tracer", "tracing", "event_time", "event_time_enabled",
     "topology_dot", "topology_json", "graph_topology_dot",
     "graph_topology_json", "pipeline_topology_dot", "pipeline_topology_json",
 ]
@@ -68,6 +72,19 @@ class MonitoringConfig:
     #: (a sample is two perf_counter reads around a sink receipt that is
     #: host-synchronous anyway — cheap, so the default is dense)
     e2e_sample_every: int = 4
+    #: event-time observability sub-toggle (off by default): per-operator
+    #: ``event_time`` snapshot sections (watermarks, state occupancy,
+    #: pending/archive pressure), the graph-level min-watermark frontier +
+    #: per-edge skew gauges, and on-device lateness histograms folded into
+    #: every stateful operator's state (``observability/event_time.py``).
+    #: GEOMETRY-BINDING: the histograms live in the operator state pytrees,
+    #: so this toggle is resolved when a chain is BUILT (the ``control=``
+    #: convention, not the lazy monitoring resolution) — off means the
+    #: compiled programs are byte-for-byte today's (zero added device work,
+    #: the perf-gate pins unchanged); on changes only the carried state,
+    #: never the results (chaos-pinned byte-identical).  Env override:
+    #: ``WF_MONITORING_EVENT_TIME`` (``''``/``'0'`` off, anything else on).
+    event_time: bool = False
 
     def should_sample_e2e(self, n: int) -> bool:
         """THE e2e sampling policy, shared by every driver: every Nth source
@@ -100,7 +117,19 @@ class MonitoringConfig:
         iv = os.environ.get("WF_MONITORING_INTERVAL")
         if iv:
             cfg = dataclasses.replace(cfg, interval_s=float(iv))
+        et = os.environ.get("WF_MONITORING_EVENT_TIME")
+        if et is not None and et != "":
+            cfg = dataclasses.replace(cfg, event_time=et != "0")
         return cfg
+
+
+def event_time_enabled(monitoring=None) -> bool:
+    """Resolve ONLY the event-time sub-toggle of a ``monitoring=`` argument
+    — the chain-construction sites call this (the toggle sizes operator
+    state, so it binds at build time; see ``MonitoringConfig.event_time``).
+    Off whenever monitoring itself resolves off."""
+    cfg = MonitoringConfig.resolve(monitoring)
+    return bool(cfg is not None and cfg.event_time)
 
 
 class Monitor:
@@ -114,7 +143,7 @@ class Monitor:
     def __init__(self, config: MonitoringConfig, name: str = "pipegraph"):
         self.config = config
         os.makedirs(config.out_dir, exist_ok=True)
-        self.registry = MetricsRegistry(name)
+        self.registry = MetricsRegistry(name, event_time=config.event_time)
         self.journal: Optional[EventJournal] = None
         if config.journal:
             self.journal = EventJournal(

@@ -1,0 +1,145 @@
+"""Event-time observability primitives: lateness histograms + delay advice.
+
+PR 8 shipped an event-time subsystem (versioned JoinTable, session tables,
+interval-join archives, leaderboards) whose health was invisible at runtime:
+an operator silently sheds ``tuples_dropped_old`` / ``match_drops`` / overflow
+drops and the only artifact is a counter — no record of *how late* the shed
+tuples were, on which stream, or what ``delay=`` would have kept them.  This
+module is the shared core of that answer:
+
+- **Lateness histogram geometry** (host side, stdlib only): ``NB`` power-of-
+  two buckets over observed lateness ``watermark - ts`` in event-time ticks.
+  Bucket 0 holds exactly-on-time tuples (lateness 0); bucket ``b >= 1`` holds
+  lateness with bit length ``b``, i.e. ``[2**(b-1), 2**b - 1]`` — so a
+  reported quantile's upper bound is within 2x of the true sample quantile,
+  the ``LogHistogram`` trade made integer-exact for event time.
+- :func:`recommend_delay`: reads a histogram and names the smallest
+  ``delay=`` (at bucket resolution) covering quantile ``q`` of the observed
+  lateness — the number an operator's lateness section puts next to its
+  drops, and the number ``scripts/wf_state.py`` renders per operator.
+- **Device-side update** (:func:`lateness_update`, lazy ``jax`` import): ONE
+  masked ``[C, NB]`` compare-reduce per batch folded into the operator's
+  carried state — read back with the existing snapshot-time stats reads, so
+  the forensics cost zero extra transfers and zero device work when the
+  ``MonitoringConfig.event_time`` toggle is off (the histogram is simply not
+  in the state pytree).
+
+This module must stay importable WITHOUT jax at module scope:
+``scripts/wf_state.py`` loads it by file path (the ``wf_trace.py`` /
+``tracing.py`` convention) to reuse the bucket math on any box the
+monitoring artifacts were copied to.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+#: lateness histogram buckets: bucket 0 = lateness 0; bucket b >= 1 =
+#: lateness with bit length b (``[2**(b-1), 2**b - 1]`` ticks).  31 is the
+#: widest bit length an int32 lateness can have, so 32 buckets are lossless.
+NB = 32
+
+
+def bucket_of(lateness: int) -> int:
+    """Bucket index of one observed lateness value (host-side mirror of the
+    device one-hot; tests pin the two agree)."""
+    lat = max(0, int(lateness))
+    return min(lat.bit_length(), NB - 1)
+
+
+def bucket_upper(i: int) -> int:
+    """Inclusive upper bound (ticks) of bucket ``i`` — the delay that covers
+    every lateness the bucket can hold."""
+    i = int(i)
+    return 0 if i <= 0 else (1 << i) - 1
+
+
+def lateness_quantile(counts: Sequence[int], q: float) -> int:
+    """Upper bound (ticks) of the bucket containing quantile ``q`` (0 < q
+    <= 1) of the recorded lateness samples; 0 when the histogram is empty."""
+    total = sum(int(c) for c in counts)
+    if total <= 0:
+        return 0
+    target = max(1, math.ceil(float(q) * total))
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += int(c)
+        if acc >= target:
+            return bucket_upper(i)
+    return bucket_upper(len(counts) - 1)
+
+
+def recommend_delay(counts: Sequence[int], q: float = 0.99) -> int:
+    """THE delay advice: the smallest ``delay=`` (at bucket resolution —
+    within 2x of the exact sample quantile) that covers quantile ``q`` of the
+    observed lateness.  An operator run with ``delay >=`` this value would
+    have accepted that fraction of its arrivals as on-time; ``q=1.0`` names
+    the delay that drives ``tuples_dropped_old`` / overflow drops to zero
+    for the recorded stream (the contract ``tests/test_event_time.py``
+    pins end to end)."""
+    return lateness_quantile(counts, q)
+
+
+def summarize(counts: Sequence[int],
+              q_recommend: float = 0.99) -> Dict[str, object]:
+    """Snapshot-ready summary of one lateness histogram: raw bucket counts
+    (so ``wf_state.py`` can re-quantile at any q), p50/p95/p99 upper bounds,
+    max-bucket bound, and the default delay recommendation."""
+    counts = [int(c) for c in counts]
+    total = sum(counts)
+    out: Dict[str, object] = {"counts": counts, "total": total}
+    if total:
+        out["p50"] = lateness_quantile(counts, 0.50)
+        out["p95"] = lateness_quantile(counts, 0.95)
+        out["p99"] = lateness_quantile(counts, 0.99)
+        last = max(i for i, c in enumerate(counts) if c)
+        out["max"] = bucket_upper(last)
+        out["recommend_delay_p99"] = recommend_delay(counts, q_recommend)
+    return out
+
+
+# ------------------------------------------------------------- device side
+#
+# jax is imported INSIDE the functions below: the module itself must load
+# without jax (wf_state.py loads it by path), and the device helpers only
+# ever run under an operator's traced ``apply`` with event_time monitoring
+# on.
+
+
+def lateness_init(nb: int = NB):
+    """Fresh on-device histogram (i32[nb]) for an operator's state pytree —
+    present ONLY when the ``event_time`` toggle resolved on at chain build,
+    so the off path's compiled program (and its perf-gate cost pins) carries
+    zero extra state."""
+    import jax.numpy as jnp
+    return jnp.zeros((int(nb),), jnp.int32)
+
+
+def lateness_update(hist, watermark, ts, mask):
+    """Fold one batch's observed lateness into the histogram: ONE masked
+    ``[C, NB]`` compare + reduction (no scatter, no gather).  ``watermark``
+    is the operator's post-batch event-time frontier (scalar), ``ts`` the
+    per-lane event times (i32[C]), ``mask`` the lanes to record (bool[C]).
+    The bucket index is the lateness bit length, computed as a threshold
+    count — integer-exact, so the host mirror :func:`bucket_of` agrees."""
+    import jax.numpy as jnp
+    nb = hist.shape[0]
+    lat = jnp.maximum(jnp.asarray(watermark, jnp.int32)
+                      - ts.astype(jnp.int32), 0)
+    # thresholds 2**0 .. 2**(nb-2): count how many are <= lat = bit length
+    th = jnp.left_shift(jnp.asarray(1, jnp.int32),
+                        jnp.arange(nb - 1, dtype=jnp.int32))
+    b = jnp.sum((lat[:, None] >= th[None, :]).astype(jnp.int32), axis=1)
+    oh = (b[:, None] == jnp.arange(nb, dtype=jnp.int32)[None, :]) \
+        & mask[:, None]
+    return hist + jnp.sum(oh.astype(jnp.int32), axis=0)
+
+
+def read_hist(hist) -> Optional[List[int]]:
+    """Host list of bucket counts from a device histogram (snapshot-time
+    read; None when the state carries no histogram)."""
+    if hist is None:
+        return None
+    import numpy as np
+    return [int(v) for v in np.asarray(hist)]

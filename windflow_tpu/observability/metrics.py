@@ -131,6 +131,43 @@ _COUNTERS = ("inputs_received", "outputs_sent", "bytes_received", "bytes_sent",
              "batches_received", "batches_sent", "num_kernels",
              "bytes_copied_hd", "bytes_copied_dh", "tuples_dropped_old")
 
+#: HELP text per event-time gauge — checked against the central registry at
+#: import so the exposition can never drift from names.py (the WF240/241
+#: one-source-of-truth discipline)
+_EVENT_TIME_HELP = {
+    "watermark": "operator event-time frontier (max event ts seen)",
+    "lag": "arrived-but-unfired event-time span",
+    "occupancy_pct": "state-table occupancy percent",
+    "pending_depth": "join-table upserts parked behind the watermark",
+    "open_sessions": "open sessions in the session table",
+    "oldest_open_age": "event-time age of the longest-open session",
+    "archive_fill_pct": "interval-join archive fill percent (max of both "
+                        "sides)",
+    "lateness_p50": "observed lateness p50 (ticks; bucket upper bound)",
+    "lateness_p99": "observed lateness p99 (ticks; bucket upper bound)",
+    "min_watermark": "graph-level min-watermark frontier",
+    "skew": "per-edge watermark skew (producer - consumer, ticks)",
+}
+
+#: snapshot section key -> registered event-time gauge name
+_EVENT_TIME_KEY_MAP = {"watermark_ts": "watermark", "lag": "lag",
+                       "occupancy_pct": "occupancy_pct",
+                       "pending_depth": "pending_depth",
+                       "open_sessions": "open_sessions",
+                       "oldest_open_age": "oldest_open_age"}
+
+
+def _check_event_time_names() -> None:
+    from .names import EVENT_TIME_GAUGES
+    if set(_EVENT_TIME_HELP) != set(EVENT_TIME_GAUGES):
+        raise RuntimeError(
+            f"metrics.py event-time exposition drifted from "
+            f"names.py::EVENT_TIME_GAUGES: "
+            f"{set(_EVENT_TIME_HELP) ^ set(EVENT_TIME_GAUGES)}")
+
+
+_check_event_time_names()
+
 
 def _recovery_counters() -> Dict[str, float]:
     """Process-wide supervision counters (lazy import: runtime.faults imports
@@ -165,8 +202,15 @@ class MetricsRegistry:
     of TB window states (a tiny D2H read — monitoring-path only).
     """
 
-    def __init__(self, name: str = "pipegraph"):
+    def __init__(self, name: str = "pipegraph", event_time: bool = False):
         self.name = name
+        #: event-time observability (MonitoringConfig.event_time): snapshot
+        #: rows grow per-operator ``event_time`` sections (watermarks, state
+        #: occupancy, lateness histograms), the snapshot a graph-level
+        #: ``event_time`` section (min-watermark frontier + per-edge skew),
+        #: and the Prometheus exposition the ``windflow_event_time_*``
+        #: gauges.  Snapshot-time D2H reads only — the monitoring path.
+        self.event_time = bool(event_time)
         self.created = time.monotonic()
         self.e2e_hist = LogHistogram()       # source framing -> sink host receipt
         self._graphs: List[Any] = []
@@ -178,6 +222,7 @@ class MetricsRegistry:
         self._queue_capacities: Dict[str, int] = {}
         # id(op) -> (t, inputs, outputs)  # wf-lint: guarded-by[_lock]
         self._prev: Dict[int, tuple] = {}
+        self._et_names: Dict[int, str] = {}   # id(op) -> name (event_time)
         self._lock = threading.Lock()
 
     # -- registration -----------------------------------------------------------------
@@ -281,6 +326,7 @@ class MetricsRegistry:
         latency percentiles, watermark gauges, queue depths, e2e latency."""
         now = time.monotonic()
         ops_out = []
+        et_secs: Dict[int, dict] = {}    # id(op) -> event_time section
         totals = {k: 0 for k in _COUNTERS}
         with self._lock:
             for op, state in self._op_units():
@@ -342,6 +388,30 @@ class MetricsRegistry:
                 wmg = self._watermark_gauge(op, state)
                 if wmg is not None:
                     row["watermark"] = wmg
+                # per-stage counters published by collect_stats (PR 8
+                # operator counters on a uniform per-operator surface)
+                sc = op.stage_counters() if hasattr(op, "stage_counters") \
+                    else {}
+                if sc:
+                    row["counters"] = sc
+                if self.event_time:
+                    import jax.errors
+                    try:
+                        sec = op.event_time_stats(state)
+                    except (RuntimeError, jax.errors.JAXTypeError):
+                        # same live-state read hazards as _watermark_gauge:
+                        # donated buffer / abstract value mid-trace
+                        sec = None
+                    if sec is not None:
+                        row["event_time"] = sec
+                        et_secs[id(op)] = sec
+                        self._et_names[id(op)] = op.getName()
+                    elif wmg is not None:
+                        # TB window ops without a richer section still carry
+                        # a frontier — include them in the watermark map
+                        et_secs[id(op)] = {"watermark_ts":
+                                           wmg["watermark_ts"]}
+                        self._et_names[id(op)] = op.getName()
                 ops_out.append(row)
         queues = {}
         for edge, fn in list(self._queue_gauges.items()):
@@ -394,9 +464,118 @@ class MetricsRegistry:
             snap["queue_capacity"] = dict(self._queue_capacities)
         if gauges:
             snap["gauges"] = gauges
+        if self.event_time:
+            et = self._event_time_section(et_secs)
+            if et:
+                snap["event_time"] = et
         return snap
 
+    def _event_time_section(self, et_secs: Dict[int, dict]) -> dict:
+        """Graph-level watermark propagation map: the min-watermark frontier
+        (the operator holding the whole graph's event time back) and the
+        per-edge watermark *skew* — producer-pipe watermark minus consumer-
+        pipe watermark over the SAME ``_iter_edges`` enumeration the
+        threaded driver builds its rings from (edge labels match queue
+        gauges and the topology export, which annotates its edges from this
+        section)."""
+        out: dict = {}
+        wms = []
+        for g in self._graphs:
+            for mp in g._all_pipes():
+                for op in mp.ops:
+                    sec = et_secs.get(id(op))
+                    if sec and "watermark_ts" in sec:
+                        wms.append((sec["watermark_ts"], op.getName()))
+        if not wms:
+            # linear pipelines / raw chains: no pipe structure — frontier
+            # from every section (the loop stored the owning op's name)
+            for oid, sec in et_secs.items():
+                if "watermark_ts" in sec:
+                    wms.append((sec["watermark_ts"],
+                                self._et_names.get(oid)))
+        if wms:
+            mn = min(wms, key=lambda t: t[0])
+            out["min_watermark_ts"] = mn[0]
+            if mn[1]:
+                out["frontier_operator"] = mn[1]
+        edges = {}
+        for g in self._graphs:
+            wm_of_pipe = {}
+            for mp in g._all_pipes():
+                pw = [et_secs[id(op)]["watermark_ts"] for op in mp.ops
+                      if id(op) in et_secs
+                      and "watermark_ts" in et_secs[id(op)]]
+                if pw:
+                    wm_of_pipe[id(mp)] = max(pw)
+            try:
+                edge_iter = list(g._iter_edges())
+            except Exception:       # noqa: BLE001 — half-built graph
+                continue
+            for prod, cons, label, _idx in edge_iter:
+                if prod is None:
+                    continue
+                a = wm_of_pipe.get(id(prod))
+                b = wm_of_pipe.get(id(cons))
+                if a is not None and b is not None:
+                    edges[label] = a - b
+        if edges:
+            out["edge_skew_ts"] = edges
+        return out
+
     # -- Prometheus text exposition ----------------------------------------------------
+
+    @staticmethod
+    def _prometheus_event_time(snap: dict, lines: List[str], esc) -> None:
+        """``windflow_event_time_*`` gauges (HELP/TYPE'd) from the snapshot's
+        event-time sections: per-operator watermark/lag/occupancy/pressure,
+        per-(operator, stream) lateness quantiles, and the graph-level
+        min-watermark frontier + per-edge skew.  Only the names registered
+        in ``names.py::EVENT_TIME_GAUGES`` render (the module-level check
+        below keeps the local maps and the registry in lockstep)."""
+        g = snap["graph"]
+        help_of = _EVENT_TIME_HELP
+        key_map = _EVENT_TIME_KEY_MAP
+        typed = set()
+
+        def head(name):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# HELP windflow_event_time_{name} "
+                             f"{help_of[name]}")
+                lines.append(f"# TYPE windflow_event_time_{name} gauge")
+
+        for row in snap["operators"]:
+            sec = row.get("event_time")
+            if not sec:
+                continue
+            lab = f'graph="{esc(g)}",operator="{esc(row["name"])}"'
+            for key, gname in key_map.items():
+                if key in sec:
+                    head(gname)
+                    lines.append(
+                        f'windflow_event_time_{gname}{{{lab}}} {sec[key]}')
+            fills = [v for k, v in sec.items() if k.endswith("_fill_pct")]
+            if fills:
+                head("archive_fill_pct")
+                lines.append(f'windflow_event_time_archive_fill_pct{{{lab}}} '
+                             f'{max(fills)}')
+            for stream, summ in (sec.get("lateness") or {}).items():
+                if not summ.get("total"):
+                    continue
+                slab = f'{lab},stream="{esc(stream)}"'
+                for q in ("p50", "p99"):
+                    head(f"lateness_{q}")
+                    lines.append(f'windflow_event_time_lateness_{q}'
+                                 f'{{{slab}}} {summ[q]}')
+        et = snap.get("event_time") or {}
+        if "min_watermark_ts" in et:
+            head("min_watermark")
+            lines.append(f'windflow_event_time_min_watermark'
+                         f'{{graph="{esc(g)}"}} {et["min_watermark_ts"]}')
+        for edge, skew in sorted((et.get("edge_skew_ts") or {}).items()):
+            head("skew")
+            lines.append(f'windflow_event_time_skew{{graph="{esc(g)}",'
+                         f'edge="{esc(edge)}"}} {skew}')
 
     def to_prometheus(self, snap: Optional[dict] = None) -> str:
         """Render the snapshot in the Prometheus text format (one scrape body).
@@ -428,6 +607,38 @@ class MetricsRegistry:
                     f'windflow_watermark_lag{{graph="{esc(g)}",'
                     f'operator="{esc(row["name"])}"}} '
                     f'{row["watermark"]["lag_ts"]}')
+        # per-stage operator counters/gauges (names.py::STAGE_COUNTERS /
+        # STAGE_GAUGES — only registered names render, the WF240/241
+        # discipline), with HELP lines: these are the PR 8 operator counters
+        # promoted to a uniform per-operator exposition
+        from .names import STAGE_COUNTERS, STAGE_GAUGES
+        stage_help = {
+            "sessions_closed": "sessions closed by the session triggerer",
+            "topn_evictions": "leaderboard candidates evicted by the top-N "
+                              "rank merge",
+            "match_drops": "interval-join matches dropped past max_matches",
+            "arch_drops": "live interval-join archive slots overwritten",
+            "overflow_drops": "join-table pending-ring/table overflow drops",
+            "old_drops": "tuples dropped as OLD behind the event-time "
+                         "frontier",
+            "join_table_version": "applied upsert count of the operator's "
+                                  "join table",
+        }
+        for c in STAGE_COUNTERS + STAGE_GAUGES:
+            rows = [r for r in snap["operators"]
+                    if c in (r.get("counters") or {})]
+            if not rows:
+                continue
+            kind = "gauge" if c in STAGE_GAUGES else "counter"
+            suffix = "" if kind == "gauge" else "_total"
+            lines.append(f"# HELP windflow_stage_{c}{suffix} "
+                         f"{stage_help.get(c, c)}")
+            lines.append(f"# TYPE windflow_stage_{c}{suffix} {kind}")
+            for row in rows:
+                lines.append(
+                    f'windflow_stage_{c}{suffix}{{graph="{esc(g)}",'
+                    f'operator="{esc(row["name"])}"}} {row["counters"][c]}')
+        self._prometheus_event_time(snap, lines, esc)
         lines.append("# TYPE windflow_queue_depth gauge")
         for edge, depth in snap["queues"].items():
             lines.append(f'windflow_queue_depth{{graph="{esc(g)}",'

@@ -48,6 +48,12 @@ JOURNAL_EVENTS = (
     "capacity_switch", "tuning_converged", "tuning_warm_start",
     # per-batch causal tracing lifecycle (observability/tracing.py Tracer)
     "trace_start", "trace_end",
+    # event-time forensics (runtime/pipeline.py CompiledChain, event_time
+    # monitoring only): a stateful operator's drop counter advanced — the
+    # record carries (op, kind, n) plus the PR 5 trace coordinates
+    # (tid/pos) of the sampled batch the readback rode, so wf_trace.py /
+    # wf_state.py join drops to traced batches
+    "lateness_drop",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -100,6 +106,42 @@ CONTROL_GAUGES = (
     # upsert count of the most recently synced table (last-write-wins
     # across tables, the chosen_capacity convention)
     "join_table_version",
+)
+
+#: per-STAGE counters exported in the metrics snapshot's operator rows
+#: (``row["counters"]``) and in Prometheus as
+#: ``windflow_stage_<name>_total`` with HELP/TYPE lines — the PR 8 operator
+#: counters promoted from process-wide totals to a uniform per-operator
+#: surface.  Operators publish them via ``Basic_Operator.
+#: _publish_stage_counters`` (which validates against this tuple, the
+#: WF240/241 one-source-of-truth discipline); ``metrics.py`` renders ONLY
+#: registered names.
+STAGE_COUNTERS = (
+    "sessions_closed",     # operators/session.py: sessions the triggerer closed
+    "topn_evictions",      # operators/rank.py: leaderboard candidates evicted
+    "match_drops",         # operators/join.py IntervalJoin: per-probe overflow
+    "arch_drops",          # operators/join.py IntervalJoin: archive overwrites
+    "overflow_drops",      # ops/lookup.py JoinTable: pending-ring/table drops
+    "old_drops",           # session/win_seqffat OLD straggler drops (also in
+    #                        tuples_dropped_old — here beside the other drops)
+)
+
+#: per-stage gauges (same surface, ``windflow_stage_<name>`` gauge form)
+STAGE_GAUGES = (
+    "join_table_version",  # applied upsert count of the op's own JoinTable
+)
+
+#: per-operator event-time gauges of the watermark propagation map
+#: (``metrics.py``: snapshot ``event_time`` sections -> Prometheus
+#: ``windflow_event_time_<name>``; only registered names are rendered).
+#: ``min_watermark`` and ``skew`` are graph-level (the frontier + per-edge
+#: watermark skew of the topology export).
+EVENT_TIME_GAUGES = (
+    "watermark",           # operator event-time frontier (max ts applied)
+    "lag", "occupancy_pct", "pending_depth", "open_sessions",
+    "oldest_open_age", "archive_fill_pct",
+    "lateness_p50", "lateness_p99",        # lateness histogram quantiles
+    "min_watermark", "skew",               # graph frontier + per-edge skew
 )
 
 #: kernel families selectable through the per-backend kernel registry

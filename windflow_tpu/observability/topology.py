@@ -58,6 +58,10 @@ def graph_topology_json(graph, snapshot: Optional[dict] = None) -> dict:
     and the Application-Tree forest."""
     rates = _rates_by_op(snapshot)
     queues = (snapshot or {}).get("queues", {})
+    # per-edge watermark skew (event-time monitoring): the registry computes
+    # it over the SAME edge-label enumeration the threaded driver rings use
+    skews = ((snapshot or {}).get("event_time") or {}).get("edge_skew_ts",
+                                                           {})
     pipes = graph._all_pipes()
     index = {id(p): i for i, p in enumerate(pipes)}
     nodes, edges = [], []
@@ -75,6 +79,8 @@ def graph_topology_json(graph, snapshot: Optional[dict] = None) -> dict:
         label = f"{src}->{dst}"
         if label in queues:
             e["queue_depth"] = queues[label]
+        if label in skews:
+            e["watermark_skew_ts"] = skews[label]
         if rate_op is not None and rate_op.getName() in rates:
             e["rate_tps"] = rates[rate_op.getName()].get("rate_out_tps")
         edges.append(e)
@@ -97,6 +103,8 @@ def graph_topology_json(graph, snapshot: Optional[dict] = None) -> dict:
     if snapshot:
         out["totals"] = snapshot.get("totals")
         out["e2e_latency_us"] = snapshot.get("e2e_latency_us")
+        if snapshot.get("event_time"):
+            out["event_time"] = snapshot["event_time"]
     return out
 
 
@@ -106,6 +114,8 @@ def graph_topology_dot(graph, snapshot: Optional[dict] = None) -> str:
     is supplied."""
     rates = _rates_by_op(snapshot)
     queues = (snapshot or {}).get("queues", {})
+    skews = ((snapshot or {}).get("event_time") or {}).get("edge_skew_ts",
+                                                           {})
     pipes = graph._all_pipes()
     index = {id(p): i for i, p in enumerate(pipes)}
     lines = [f'digraph "{graph.name}" {{', "  rankdir=LR;"]
@@ -132,6 +142,8 @@ def graph_topology_dot(graph, snapshot: Optional[dict] = None) -> str:
         key = f"{src}->{dst}"
         if key in queues:
             label += f" depth={queues[key]}"
+        if key in skews:
+            label += f" skew={skews[key]}"
         return f'[label="{label}"]'
 
     for p in pipes:

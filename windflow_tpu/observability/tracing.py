@@ -334,14 +334,23 @@ class Tracer:
             return
         self._seg().add((time.perf_counter(), tid, stage, kind, None))
 
-    def service(self, batch, stage: str) -> Optional[_ServiceSpan]:
+    def service(self, batch, stage: str,
+                k: Optional[int] = None) -> Optional[_ServiceSpan]:
         """Open a service span for a traced batch; the caller invokes
-        ``.done()`` after the stage's work.  None for untraced batches."""
+        ``.done()`` after the stage's work.  None for untraced batches.
+
+        ``k`` marks FUSED-GROUP membership (scan dispatch, ``WF_DISPATCH``
+        with K>1): all K member spans cover the same one compiled launch, so
+        the begin record carries ``k`` and the report's per-batch service
+        attribution divides the span by it — without the marker a fused
+        group would charge its whole service span to every member and the
+        stage breakdown would overcount K-fold."""
         tid = getattr(batch, TRACE_META_ATTR, None)
         if tid is None:
             return None
         seg = self._seg()
-        seg.add((time.perf_counter(), tid, stage, K_BEGIN, None))
+        extra = {"k": int(k)} if k is not None and k > 1 else None
+        seg.add((time.perf_counter(), tid, stage, K_BEGIN, extra))
         seg.open_spans.append((tid, stage))
         return _ServiceSpan(self, seg, tid, stage)
 
@@ -426,10 +435,11 @@ def event(batch, stage: str, kind: str) -> None:
         tr.event(batch, stage, kind)
 
 
-def service(batch, stage: str) -> Optional[_ServiceSpan]:
+def service(batch, stage: str, k: Optional[int] = None
+            ) -> Optional[_ServiceSpan]:
     tr = _active
     if tr is not None:
-        return tr.service(batch, stage)
+        return tr.service(batch, stage, k=k)
     return None
 
 
@@ -535,6 +545,8 @@ def to_chrome_trace(records: List[dict], journal_events: Optional[list] = None,
             if b is None:
                 continue                  # end without begin (ring wrapped)
             args: Dict[str, Any] = {"trace_id": hex(tid)}
+            if b.get("k"):
+                args["fused_k"] = b["k"]  # scan-dispatch group membership
             if r.get("aborted"):
                 args["aborted"] = r["aborted"]
             tk = track(stage)
@@ -615,7 +627,13 @@ def to_chrome_trace(records: List[dict], journal_events: Optional[list] = None,
 
 def _batch_lifecycles(records: List[dict]) -> Dict[int, dict]:
     """Fold records into per-trace-id lifecycles: ingest time, end time,
-    per-stage service durations, per-edge queue waits, aborted-span count."""
+    per-stage service durations, per-edge queue waits, aborted-span count.
+
+    Fused-dispatch apportionment: a span whose begin record carries ``k``
+    (scan dispatch, K>1) covers ONE compiled launch shared by K group
+    members, so each member is charged ``span / k`` — the per-batch drill-
+    down stays honest under ``WF_DISPATCH`` instead of charging the whole
+    group service span to every member."""
     out: Dict[int, dict] = {}
 
     def life(tid):
@@ -624,10 +642,10 @@ def _batch_lifecycles(records: List[dict]) -> Dict[int, dict]:
             lc = out[tid] = {"tid": tid, "pos": None, "stream": None,
                              "t_ingest": None, "t_end": None,
                              "service": {}, "queue": {}, "aborts": 0,
-                             "attempts": {}}
+                             "attempts": {}, "fused": 0}
         return lc
 
-    open_begin: Dict[tuple, float] = {}
+    open_begin: Dict[tuple, tuple] = {}    # (tid, stage) -> (t, k or None)
     enq_at: Dict[tuple, float] = {}
     for r in sorted(records, key=lambda x: x["t"]):
         tid, stage, kind, t = r["tid"], r["stage"], r["kind"], r["t"]
@@ -641,12 +659,17 @@ def _batch_lifecycles(records: List[dict]) -> Dict[int, dict]:
                 lc["pos"] = r.get("pos")
                 lc["stream"] = r.get("stream")
         elif kind == K_BEGIN:
-            open_begin[(tid, stage)] = t
+            open_begin[(tid, stage)] = (t, r.get("k"))
             lc["attempts"][stage] = lc["attempts"].get(stage, 0) + 1
         elif kind == K_END:
             b = open_begin.pop((tid, stage), None)
             if b is not None:
-                lc["service"][stage] = lc["service"].get(stage, 0.0) + (t - b)
+                t0, k = b
+                dur = t - t0
+                if k and int(k) > 1:
+                    dur /= int(k)         # fused group: this batch's share
+                    lc["fused"] += 1
+                lc["service"][stage] = lc["service"].get(stage, 0.0) + dur
             if r.get("aborted"):
                 lc["aborts"] += 1
         elif kind == K_ENQ:
@@ -721,6 +744,9 @@ def critical_path_report(records: List[dict],
     shed_pos = {p for _s, p in shed_keys}
     dead_pos = {e.get("at_batch") for e in jevents
                 if e.get("event") == "dead_letter"}
+    # event-time drop forensics (event_time monitoring): each record carries
+    # the trace coordinates of the sampled batch whose readback surfaced it
+    late_drops = [e for e in jevents if e.get("event") == "lateness_drop"]
 
     def _is_shed(lc) -> bool:
         return ((lc["stream"], lc["pos"]) in shed_keys
@@ -760,6 +786,22 @@ def critical_path_report(records: List[dict],
     if dead_pos:
         lines.append(f"  dead-letter       {len(dead_pos)} batches at pos "
                      f"{sorted(p for p in dead_pos if p is not None)}")
+    if late_drops:
+        lines.append("")
+        lines.append("event-time drops (lateness_drop journal; joined to "
+                     "traced batches by the sampled readback's coordinates):")
+        for e in late_drops:
+            where = ""
+            if e.get("pos") is not None:
+                tid = e.get("tid")
+                traced = tid is not None and int(tid) in lives
+                where = (f"  at/before pos={e['pos']}"
+                         f" (batch {int(tid):#x}"
+                         f"{', traced' if traced else ''})"
+                         if tid is not None else f"  at/before pos={e['pos']}")
+            lines.append(f"  {e.get('op', '?'):<24} {e.get('kind', '?'):<16} "
+                         f"+{e.get('n', 0)} (total {e.get('total', '?')})"
+                         f"{where}")
 
     # -- per-batch phase attribution --------------------------------------
     def phases(lc) -> dict:
@@ -778,6 +820,9 @@ def critical_path_report(records: List[dict],
 
     def flags(lc) -> str:
         f = []
+        if lc.get("fused"):
+            # service figures are the batch's 1/k share of fused launches
+            f.append("FUSED")
         if _is_shed(lc):
             f.append("SHED")
         if lc["pos"] in dead_pos:

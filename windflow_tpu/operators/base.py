@@ -39,6 +39,14 @@ class Basic_Operator:
     #: ``wf/pipegraph.hpp:1272-1318``), False when it fell back to routed add;
     #: None before graph placement. Rendered by dump_DOTGraph.
     _chained = None
+    #: event-time observability toggle (``MonitoringConfig.event_time``), set
+    #: by CompiledChain BEFORE ``bind_geometry``/``init_state`` when the
+    #: enclosing driver resolved the sub-toggle on.  Geometry-binding: when
+    #: True, stateful event-time operators add an on-device lateness
+    #: histogram to their state pytree and fold one masked reduction per
+    #: batch into it (``observability/event_time.py``); when False (the
+    #: default) state and compiled programs are byte-for-byte unchanged.
+    _event_time = False
 
     def __init__(self, name: str, parallelism: int = 1):
         self._name = name
@@ -84,6 +92,44 @@ class Basic_Operator:
         ``Stats_Record`` (e.g. Win_SeqFFAT's OLD-drop counter). Called by the
         metrics registry at snapshot time and by the drivers at EOS — a tiny
         D2H read off the hot path; no-op by default."""
+
+    def _publish_stage_counters(self, counters: dict) -> None:
+        """Stash per-operator counters/gauges for the snapshot's
+        ``row["counters"]`` and the ``windflow_stage_*`` Prometheus surface.
+        Names must be registered in ``observability/names.py`` — the
+        WF240/241 one-source-of-truth discipline applied to the per-stage
+        namespace (a typo'd name raises here instead of silently forking the
+        exposition)."""
+        from ..observability.names import STAGE_COUNTERS, STAGE_GAUGES
+        for k in counters:
+            if k not in STAGE_COUNTERS and k not in STAGE_GAUGES:
+                raise ValueError(
+                    f"{self._name}: stage counter {k!r} is not registered in "
+                    f"observability/names.py::STAGE_COUNTERS/STAGE_GAUGES — "
+                    f"register it there (the emission registries the linter "
+                    f"gates)")
+        self._stage_counters = dict(counters)
+
+    def stage_counters(self) -> dict:
+        """Most recently published per-operator counters (empty until the
+        first ``collect_stats`` of an operator that publishes any)."""
+        return dict(getattr(self, "_stage_counters", ()) or {})
+
+    def event_time_stats(self, state: Any = None) -> Optional[dict]:
+        """Event-time section of the monitoring snapshot's operator row
+        (watermark frontier, state occupancy/pressure, lateness histograms)
+        — None for operators without an event-time surface.  Called at
+        snapshot time only (reporter thread / EOS): implementations may do
+        small D2H reads of carried state, exactly like ``collect_stats``."""
+        return None
+
+    def drop_counters(self, state: Any = None) -> dict:
+        """Host ints of the operator's device-resident drop counters, keyed
+        by the ``names.py::STAGE_COUNTERS`` drop names — read by the chain's
+        sampled-push readback (event_time monitoring only) to journal
+        ``lateness_drop`` events with trace coordinates.  Empty by
+        default."""
+        return {}
 
     # pythonic aliases
     name = property(getName)

@@ -39,8 +39,10 @@ import jax.numpy as jnp
 
 from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
 from ..batch import Batch, CTRL_DTYPE, TupleRef, tuple_refs
+from ..observability import event_time as _et
 from ..ops.lookup import (JOIN_KEY_SENTINEL, join_table_init,
-                          join_table_probe, join_table_upsert)
+                          join_table_probe, join_table_stats,
+                          join_table_upsert)
 from .base import Basic_Operator
 
 _IMIN = -(1 << 31)
@@ -119,8 +121,14 @@ class StreamTableJoin(Basic_Operator):
 
     def init_state(self, payload_spec: Any):
         pending = self._pending_resolved or 2 * DEFAULT_MAX_KEYS
-        return join_table_init(self.num_slots, pending,
-                               self._val_spec(payload_spec))
+        state = join_table_init(self.num_slots, pending,
+                                self._val_spec(payload_spec))
+        if self._event_time:
+            # build-side lateness histogram (event-time observability only:
+            # absent from the state pytree — and from the compiled program —
+            # when the toggle is off)
+            state["lat_hist"] = _et.lateness_init()
+        return state
 
     def out_spec(self, payload_spec: Any) -> Any:
         vspec = self._val_spec(payload_spec)
@@ -136,6 +144,11 @@ class StreamTableJoin(Basic_Operator):
         # including its own batch (the as-of-watermark read point)
         state = join_table_upsert(state, jkey, bval, batch.ts, batch.id,
                                   build, delay=self.delay)
+        if self._event_time:
+            # observed build-side lateness vs the post-upsert watermark: one
+            # masked reduction, results untouched (the hist is state-only)
+            state = dict(state, lat_hist=_et.lateness_update(
+                state["lat_hist"], state["wm"], batch.ts, build))
         vals, hit = join_table_probe(state, jkey, probe_mask)
         payload = jax.vmap(self._emit)(refs, vals)
         valid = probe_mask & (hit | self.emit_misses)
@@ -150,6 +163,28 @@ class StreamTableJoin(Basic_Operator):
         if v != self._version_synced:
             self._version_synced = v
             _cstate.set_gauge("join_table_version", float(v))
+        self._publish_stage_counters({
+            "join_table_version": v,
+            "overflow_drops": int(np.asarray(state["dropped"]))})
+
+    def drop_counters(self, state: Any = None) -> dict:
+        if state is None:
+            return {}
+        import numpy as np
+        return {"overflow_drops": int(np.asarray(state["dropped"]))}
+
+    def event_time_stats(self, state: Any = None):
+        """Watermark-map section: build watermark, applied version, table
+        occupancy, pending-ring pressure, and the build-side lateness
+        histogram with its ``recommend_delay`` advice."""
+        if state is None:
+            return None
+        out = join_table_stats(state)
+        out["delay"] = self.delay
+        counts = _et.read_hist(state.get("lat_hist"))
+        if counts is not None:
+            out["lateness"] = {"build": _et.summarize(counts)}
+        return out
 
 
 class IntervalJoin(Basic_Operator):
@@ -227,12 +262,18 @@ class IntervalJoin(Basic_Operator):
                     lambda s: jnp.zeros((A,) + tuple(s.shape), s.dtype),
                     payload_spec),
             }
-        return {"l": side(), "r": side(),
-                "lcur": jnp.asarray(0, jnp.int32),
-                "rcur": jnp.asarray(0, jnp.int32),
-                "wm": jnp.asarray(_IMIN, jnp.int32),
-                "match_drops": jnp.asarray(0, jnp.int32),
-                "arch_drops": jnp.asarray(0, jnp.int32)}
+        state = {"l": side(), "r": side(),
+                 "lcur": jnp.asarray(0, jnp.int32),
+                 "rcur": jnp.asarray(0, jnp.int32),
+                 "wm": jnp.asarray(_IMIN, jnp.int32),
+                 "match_drops": jnp.asarray(0, jnp.int32),
+                 "arch_drops": jnp.asarray(0, jnp.int32)}
+        if self._event_time:
+            # per-side observed-lateness histograms (event-time monitoring
+            # only — absent otherwise, so the off program is unchanged)
+            state["lat_l"] = _et.lateness_init()
+            state["lat_r"] = _et.lateness_init()
+        return state
 
     def _event_ts(self, refs, is_l, batch):
         if self.ts_l is None and self.ts_r is None:
@@ -359,7 +400,61 @@ class IntervalJoin(Basic_Operator):
                                     batch)
         r, rcur, odr = self._append(r, state["rcur"], rmask, batch.key, ets,
                                     batch)
-        state = {"l": l, "r": r, "lcur": lcur, "rcur": rcur, "wm": wm,
-                 "match_drops": state["match_drops"] + lrows[5] + rrows[5],
-                 "arch_drops": state["arch_drops"] + odl + odr}
-        return state, out
+        new_state = {"l": l, "r": r, "lcur": lcur, "rcur": rcur, "wm": wm,
+                     "match_drops": state["match_drops"] + lrows[5] + rrows[5],
+                     "arch_drops": state["arch_drops"] + odl + odr}
+        if self._event_time:
+            # per-stream lateness vs the post-batch watermark: one masked
+            # reduction per side, state-only (results untouched)
+            new_state["lat_l"] = _et.lateness_update(
+                state["lat_l"], wm, ets, lmask)
+            new_state["lat_r"] = _et.lateness_update(
+                state["lat_r"], wm, ets, rmask)
+        return new_state, out
+
+    def collect_stats(self, state: Any = None) -> None:
+        if state is None:
+            return
+        self._publish_stage_counters(self.drop_counters(state))
+
+    def drop_counters(self, state: Any = None) -> dict:
+        if state is None:
+            return {}
+        import numpy as np
+        return {"match_drops": int(np.asarray(state["match_drops"])),
+                "arch_drops": int(np.asarray(state["arch_drops"]))}
+
+    def event_time_stats(self, state: Any = None):
+        """Watermark-map section: per-side archive fill, the watermark
+        eviction frontiers, overflow/match drops, and per-stream lateness
+        histograms."""
+        if state is None:
+            return None
+        import numpy as np
+        A = int(state["l"]["key"].shape[0])
+        lfill = int(np.asarray(state["l"]["ok"]).sum())
+        rfill = int(np.asarray(state["r"]["ok"]).sum())
+        wm = int(np.asarray(state["wm"]))
+        horizon = wm - self.delay
+        out = {
+            "watermark_ts": wm,
+            "delay": self.delay,
+            "archive_slots": A,
+            "l_fill": lfill, "r_fill": rfill,
+            "l_fill_pct": round(100.0 * lfill / A, 2),
+            "r_fill_pct": round(100.0 * rfill / A, 2),
+            # a side's archived tuple below its frontier can no longer match
+            # any future arrival and is evicted on the next batch
+            "evict_frontier_l_ts": horizon - self.upper,
+            "evict_frontier_r_ts": horizon + self.lower,
+            "match_drops": int(np.asarray(state["match_drops"])),
+            "arch_drops": int(np.asarray(state["arch_drops"])),
+        }
+        lat = {}
+        for stream, key in (("l", "lat_l"), ("r", "lat_r")):
+            counts = _et.read_hist(state.get(key))
+            if counts is not None:
+                lat[stream] = _et.summarize(counts)
+        if lat:
+            out["lateness"] = lat
+        return out

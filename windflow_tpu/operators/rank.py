@@ -150,6 +150,20 @@ class TopN(Basic_Operator):
         if ev > self._evict_synced:
             _cstate.bump("topn_evictions", ev - self._evict_synced)
             self._evict_synced = ev
+        self._publish_stage_counters({"topn_evictions": ev})
+
+    def event_time_stats(self, state: Any = None):
+        """Watermark-map section: leaderboard fill + eviction pressure
+        (TopN has no event-time frontier — scores, not timestamps)."""
+        if state is None:
+            return None
+        import numpy as np
+        filled = int((np.asarray(state["score"]) != TOPN_SENTINEL).sum())
+        slots = self.num_keys * self.n
+        return {"leaderboard_slots": slots,
+                "leaderboard_filled": filled,
+                "occupancy_pct": round(100.0 * filled / slots, 2),
+                "topn_evictions": int(np.asarray(state["evict"]))}
 
 
 class Distinct(Basic_Operator):
@@ -192,3 +206,24 @@ class Distinct(Basic_Operator):
         state = join_table_upsert(state, dk, {"one": ones}, batch.ts,
                                   batch.id, keep, delay=0)
         return state, batch.mask(keep)
+
+    def collect_stats(self, state: Any = None) -> None:
+        if state is None:
+            return
+        self._publish_stage_counters(self.drop_counters(state))
+
+    def drop_counters(self, state: Any = None) -> dict:
+        if state is None:
+            return {}
+        import numpy as np
+        return {"overflow_drops": int(np.asarray(state["dropped"]))}
+
+    def event_time_stats(self, state: Any = None):
+        """Watermark-map section: distinct-table occupancy + overflow drops
+        (the delay-0 JoinTable underneath)."""
+        if state is None:
+            return None
+        from ..ops.lookup import join_table_stats
+        out = join_table_stats(state)
+        out["delay"] = 0
+        return out

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 
 from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
 from ..batch import Batch, CTRL_DTYPE, TupleRef, tuple_refs
+from ..observability import event_time as _et
 from ..ops.lookup import table_lookup
 from ..ops.segment import segment_reduce
 from .base import Basic_Operator
@@ -114,13 +115,18 @@ class SessionWindow(Basic_Operator):
         acc = jax.tree.map(
             lambda s: jnp.zeros((K,) + tuple(s.shape), s.dtype), vspec)
         z = lambda fill=0: jnp.full((K,), fill, jnp.int32)
-        return {"open": jnp.zeros((K,), jnp.bool_),
-                "start": z(), "last": z(), "cnt": z(), "sid": z(),
-                "acc": acc, "floor": z(_IMIN),
-                "wm": jnp.asarray(_IMIN, jnp.int32),
-                "closed": jnp.asarray(0, jnp.int32),
-                "old": jnp.asarray(0, jnp.int32),
-                "eos": jnp.asarray(0, jnp.int32)}
+        state = {"open": jnp.zeros((K,), jnp.bool_),
+                 "start": z(), "last": z(), "cnt": z(), "sid": z(),
+                 "acc": acc, "floor": z(_IMIN),
+                 "wm": jnp.asarray(_IMIN, jnp.int32),
+                 "closed": jnp.asarray(0, jnp.int32),
+                 "old": jnp.asarray(0, jnp.int32),
+                 "eos": jnp.asarray(0, jnp.int32)}
+        if self._event_time:
+            # observed-lateness histogram (event-time monitoring only —
+            # absent otherwise, so the off program is unchanged)
+            state["lat_hist"] = _et.lateness_init()
+        return state
 
     # -- the batched session step -----------------------------------------
 
@@ -231,6 +237,13 @@ class SessionWindow(Basic_Operator):
                      + jnp.sum(g3.astype(jnp.int32)),
                      "old": state["old"] + jnp.sum(old.astype(jnp.int32)),
                      "eos": state["eos"]}
+        if self._event_time:
+            # arrival lateness vs the post-batch watermark: one masked
+            # reduction, state-only (results untouched).  delay >= the
+            # recorded quantile keeps that fraction of arrivals inside their
+            # session's lateness allowance.
+            new_state["lat_hist"] = _et.lateness_update(
+                state["lat_hist"], wm2, batch.ts, batch.valid)
         return new_state, out
 
     def _emit_rows(self, C, K, g1, g2, g3):
@@ -285,3 +298,41 @@ class SessionWindow(Basic_Operator):
         if closed > self._closed_synced:
             _cstate.bump("sessions_closed", closed - self._closed_synced)
             self._closed_synced = closed
+        self._publish_stage_counters({"sessions_closed": closed,
+                                      "old_drops": old})
+
+    def drop_counters(self, state: Any = None) -> dict:
+        if state is None:
+            return {}
+        import numpy as np
+        return {"old_drops": int(np.asarray(state["old"]))}
+
+    def event_time_stats(self, state: Any = None):
+        """Watermark-map section: open-session pressure (count + oldest-open
+        age vs the watermark), close/drop totals, and the arrival-lateness
+        histogram with its ``recommend_delay`` advice."""
+        if state is None:
+            return None
+        import numpy as np
+        wm = int(np.asarray(state["wm"]))
+        open_mask = np.asarray(state["open"])
+        n_open = int(open_mask.sum())
+        out = {
+            "watermark_ts": wm,
+            "gap": self.spec.gap,
+            "delay": self.spec.delay,
+            "open_sessions": n_open,
+            "key_slots": self.num_keys,
+            "occupancy_pct": round(100.0 * n_open / self.num_keys, 2),
+            "sessions_closed": int(np.asarray(state["closed"])),
+            "old_drops": int(np.asarray(state["old"])),
+        }
+        if n_open:
+            # age of the longest-open session: how much event time the
+            # watermark has advanced past its first event
+            start = np.asarray(state["start"])
+            out["oldest_open_age"] = max(0, wm - int(start[open_mask].min()))
+        counts = _et.read_hist(state.get("lat_hist"))
+        if counts is not None:
+            out["lateness"] = {"in": _et.summarize(counts)}
+        return out

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
 from ..batch import Batch, CTRL_DTYPE, TupleRef
+from ..observability import event_time as _et
 from ..ops.segment import segment_reduce
 from .base import Basic_Operator
 from .window import WindowSpec
@@ -48,6 +49,10 @@ class FFATState:
     wm: jax.Array         # i32[K] per-key max ts
     next_win: jax.Array   # i32[K]
     dropped_old: jax.Array  # i32[] tuples dropped as OLD (TB straggler drops)
+    #: i32[NB] observed-lateness histogram (event-time monitoring only —
+    #: None otherwise, an empty pytree subtree, so the off program is
+    #: unchanged; observability/event_time.py)
+    lat_hist: Any = None
 
 
 @jax.tree_util.register_dataclass
@@ -62,6 +67,8 @@ class GFFATState:
     wm: jax.Array         # i32[] global max ts seen
     next_win: jax.Array   # i32[] next window id to fire (global)
     dropped_old: jax.Array  # i32[] tuples dropped as OLD (pane < fired horizon)
+    #: i32[NB] observed-lateness histogram (event-time monitoring only)
+    lat_hist: Any = None
 
 
 class Win_SeqFFAT(Basic_Operator):
@@ -141,6 +148,10 @@ class Win_SeqFFAT(Basic_Operator):
     def init_state(self, payload_spec: Any):
         K, P = self.num_keys, self.P
         agg = self._lift_spec(payload_spec)
+        # lateness histogram: event-time monitoring on TB specs only (CB has
+        # no event-time frontier); None = absent from the pytree
+        lat = (_et.lateness_init()
+               if self._event_time and not self.spec.is_cb else None)
         if self.global_time:
             return GFFATState(
                 panes=jax.tree.map(
@@ -151,6 +162,7 @@ class Win_SeqFFAT(Basic_Operator):
                 wm=jnp.asarray(-1, CTRL_DTYPE),
                 next_win=jnp.asarray(0, CTRL_DTYPE),
                 dropped_old=jnp.zeros((), CTRL_DTYPE),
+                lat_hist=lat,
             )
         return FFATState(
             panes=jax.tree.map(
@@ -163,6 +175,7 @@ class Win_SeqFFAT(Basic_Operator):
             wm=jnp.full((K,), -1, CTRL_DTYPE),
             next_win=jnp.zeros((K,), CTRL_DTYPE),
             dropped_old=jnp.zeros((), CTRL_DTYPE),
+            lat_hist=lat,
         )
 
     def out_spec(self, payload_spec: Any) -> Any:
@@ -215,12 +228,22 @@ class Win_SeqFFAT(Basic_Operator):
                 panes = jax.tree.map(
                     lambda t, u: self.combine(t, u.reshape((K, P) + u.shape[1:])),
                     state.panes, upd)
+        wm_new = jnp.maximum(state.wm,
+                             jnp.max(jnp.where(batch.valid, batch.ts, -1)))
+        lat = state.lat_hist
+        if lat is not None:
+            # observed lateness vs the post-batch global watermark: one
+            # masked reduction, state-only (event-time monitoring).  A
+            # delay >= the recorded max keeps every straggler's pane ahead
+            # of the fired horizon — zero OLD drops (recommend_delay).
+            lat = _et.lateness_update(lat, wm_new, batch.ts, batch.valid)
         return dataclasses.replace(
             state,
             panes=panes,
             cnt=cnt,
-            wm=jnp.maximum(state.wm, jnp.max(jnp.where(batch.valid, batch.ts, -1))),
+            wm=wm_new,
             dropped_old=state.dropped_old + n_dropped,
+            lat_hist=lat,
         )
 
     def _g_emit(self, state: GFFATState, W_n: int, flush: bool):
@@ -349,14 +372,23 @@ class Win_SeqFFAT(Basic_Operator):
         counts_add = segment_reduce(valid.astype(CTRL_DTYPE), batch.key, valid, K)
         ts_max = segment_reduce(batch.ts, batch.key, valid, K,
                                 combine=jnp.maximum, identity=-1)
+        wm_new = jnp.maximum(state.wm, ts_max)
+        lat = state.lat_hist
+        if lat is not None:
+            # per-key TB path: lateness vs the MAX per-key watermark — the
+            # cross-key skew measure (a lagging key's tuples land in high
+            # buckets even though its own frontier fires late)
+            lat = _et.lateness_update(lat, jnp.max(wm_new), batch.ts,
+                                      batch.valid)
         return dataclasses.replace(
             state,
             panes=jax.tree.map(fold, state.panes, upd),
             pane_count=jnp.where(fresh, 0, state.pane_count) + cnt_upd.reshape(K, P),
             pane_of=new_pane_of,
             count=state.count + counts_add,
-            wm=jnp.maximum(state.wm, ts_max),
+            wm=wm_new,
             dropped_old=state.dropped_old + n_dropped,
+            lat_hist=lat,
         )
 
     # ------------------------------------------------------------------ fire
@@ -451,7 +483,38 @@ class Win_SeqFFAT(Basic_Operator):
         if state is None or not hasattr(state, "dropped_old"):
             return
         import numpy as np
-        self._stats[0].tuples_dropped_old = int(np.asarray(state.dropped_old))
+        old = int(np.asarray(state.dropped_old))
+        self._stats[0].tuples_dropped_old = old
+        self._publish_stage_counters({"old_drops": old})
+
+    def drop_counters(self, state=None) -> dict:
+        if state is None or not hasattr(state, "dropped_old"):
+            return {}
+        import numpy as np
+        return {"old_drops": int(np.asarray(state.dropped_old))}
+
+    def event_time_stats(self, state=None):
+        """Watermark-map section (TB specs): the event-time frontier, the
+        fired-window horizon, arrived-but-unfired lag, OLD drops, and the
+        observed-lateness histogram whose ``recommend_delay`` names the
+        smallest ``delay=`` that would have kept the recorded stragglers."""
+        if state is None or self.spec.is_cb:
+            return None
+        import numpy as np
+        wm = int(np.asarray(state.wm).max())
+        nxt = int(np.asarray(state.next_win).max())
+        frontier = nxt * self.spec.slide
+        out = {
+            "watermark_ts": wm,
+            "fire_frontier_ts": frontier,
+            "lag": max(wm - frontier + 1, 0) if wm >= 0 else 0,
+            "delay": self.spec.delay,
+            "old_drops": int(np.asarray(state.dropped_old)),
+        }
+        counts = _et.read_hist(getattr(state, "lat_hist", None))
+        if counts is not None:
+            out["lateness"] = {"in": _et.summarize(counts)}
+        return out
 
 
 def _detect_count_lift(lift, batch) -> bool:

@@ -453,6 +453,30 @@ def join_table_pending(state: dict) -> jax.Array:
     return jnp.sum(state["pok"].astype(jnp.int32))
 
 
+def join_table_stats(state: dict) -> dict:
+    """Host-side health snapshot of one JoinTable state (event-time
+    observability, snapshot-time only — a few small D2H reads, never on the
+    hot path): build watermark, applied version, table occupancy, pending-
+    ring depth, and overflow drops.  The numbers behind the ``event_time``
+    sections of ``StreamTableJoin``/``Distinct`` snapshot rows and the
+    ``wf_state.py`` state-pressure report."""
+    import numpy as np
+    K = int(state["key"].shape[0])
+    P = int(state["pkey"].shape[0])
+    used = int(np.asarray(state["used"]).sum())
+    pending = int(np.asarray(state["pok"]).sum())
+    return {
+        "watermark_ts": int(np.asarray(state["wm"])),
+        "applied_version": int(np.asarray(state["version"])),
+        "table_slots": K,
+        "table_used": used,
+        "occupancy_pct": round(100.0 * used / K, 2),
+        "pending_depth": pending,
+        "pending_capacity": P,
+        "overflow_drops": int(np.asarray(state["dropped"])),
+    }
+
+
 def _factored_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     """Row-select by one-hot matmul over K1, column-select on the VPU over K2."""
     import math

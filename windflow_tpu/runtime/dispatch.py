@@ -129,7 +129,10 @@ def fused_push(chain, group: List, label: str) -> List:
     ``push`` executable (the K=1 degenerate — same trace, same sampling
     path); outputs return in batch order for the caller to deliver."""
     from ..observability import tracing as _tracing
-    spans = [_tracing.service(b, label) for b in group]
+    # K>1: mark every member span with the group size so the trace report
+    # apportions the one fused launch across the K trace ids (wf_trace.py's
+    # per-batch drill-down stays honest under WF_DISPATCH)
+    spans = [_tracing.service(b, label, k=len(group)) for b in group]
     outs = (chain.push_many(group) if len(group) > 1
             else [chain.push(group[0])])
     for b, out, span in zip(group, outs, spans):

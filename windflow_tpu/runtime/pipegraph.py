@@ -263,8 +263,13 @@ class MultiPipe:
 
     def _compile(self, batch_capacity: int):
         if self._chain is None:
-            self._chain = CompiledChain(self.ops, self._in_payload_spec(),
-                                        batch_capacity=batch_capacity)
+            # event-time sub-toggle: geometry-binding (lateness histograms
+            # live in operator state), resolved from the graph's monitoring=
+            from ..observability import event_time_enabled
+            self._chain = CompiledChain(
+                self.ops, self._in_payload_spec(),
+                batch_capacity=batch_capacity,
+                event_time=event_time_enabled(self.graph._monitoring_arg))
         return self._chain
 
 

@@ -90,8 +90,24 @@ class CompiledChain:
     SERVICE_SAMPLE_EVERY = 16
 
     def __init__(self, ops: Sequence[Basic_Operator], in_spec: Any,
-                 batch_capacity: int = None):
+                 batch_capacity: int = None, event_time: bool = None):
         self.ops = list(ops)
+        # event-time observability toggle (MonitoringConfig.event_time) —
+        # GEOMETRY-BINDING: stateful operators add lateness histograms to
+        # their state pytrees, so it must be known before init_state below.
+        # None consults WF_MONITORING/WF_MONITORING_EVENT_TIME; the drivers
+        # pass their own monitoring= resolution.  Off (the default) leaves
+        # state and compiled programs byte-for-byte unchanged.
+        if event_time is None:
+            from ..observability import event_time_enabled
+            event_time = event_time_enabled(None)
+        self.event_time = bool(event_time)
+        for op in self.ops:
+            # set unconditionally: operator instances reused across chains
+            # must not keep a previous chain's toggle (sticky True would
+            # compile histograms into an off chain's state)
+            op._event_time = self.event_time
+        self._drop_synced = {}      # id(op) -> {kind: last journaled value}
         self.specs = [in_spec]          # specs[i] = input payload spec of ops[i]
         if batch_capacity is None:
             batch_capacity = resolve_batch_hint(self.ops)
@@ -226,6 +242,11 @@ class CompiledChain:
         else:
             service_s = None
         self.states = list(states)
+        if sampled:
+            # the fused launch is already synced: fold the event-time drop
+            # readback into it (coordinates = the group's first traced batch)
+            self._journal_drops(next(
+                (b for b in batches if _tracing.tid_of(b) is not None), None))
         self._push_count += k
         outs = unstack_batches(outs_stacked, k)
         # batch/byte counters mirror push: K batches per op, static shapes
@@ -281,6 +302,11 @@ class CompiledChain:
         else:
             service_s = None
         self.states = list(states)
+        if sampled:
+            # the sampled push already paid the block_until_ready: fold the
+            # event-time drop readback (lateness_drop journal events carrying
+            # this batch's trace coordinates) into the same sync
+            self._journal_drops(batch)
         # batch counters are per-op; ops[from_op:] execute as ONE fused compiled
         # program, so num_kernels counts ONE launch, attributed to the entry op
         # (reference GPU Stats_Record fields, wf/stats_record.hpp:76-80).
@@ -333,6 +359,38 @@ class CompiledChain:
         metrics registry at snapshot time."""
         for op, st in zip(self.ops, self.states):
             op.collect_stats(st)
+        self._journal_drops(None)
+
+    def _journal_drops(self, batch) -> None:
+        """Event-time drop forensics: journal ``lateness_drop`` events for
+        every operator drop counter that advanced since the last readback,
+        carrying the PR 5 trace coordinates of ``batch`` (the sampled batch
+        whose existing block_until_ready this read rides — zero extra
+        syncs; EOS passes None).  ``wf_trace.py``/``wf_state.py`` join the
+        events to traced batches on (tid, pos).  No-op unless event_time
+        monitoring is on AND a journal is active."""
+        if not self.event_time or _journal.get_active() is None:
+            return
+        tid = _tracing.tid_of(batch) if batch is not None else None
+        for op, st in zip(self.ops, self.states):
+            try:
+                counters = op.drop_counters(st)
+            except Exception:   # noqa: BLE001 — telemetry must not kill a run
+                continue
+            if not counters:
+                continue
+            prev = self._drop_synced.setdefault(id(op), {})
+            for kind, val in counters.items():
+                delta = int(val) - prev.get(kind, 0)
+                if delta <= 0:
+                    continue
+                prev[kind] = int(val)
+                fields = {"op": op.getName(), "kind": kind, "n": delta,
+                          "total": int(val)}
+                if tid is not None:
+                    fields["tid"] = int(tid)
+                    fields["pos"] = _tracing.trace_pos(tid)
+                _journal.record("lateness_drop", **fields)
 
     def result(self):
         """Results of any ReduceSink-style terminal ops (device accumulators)."""
@@ -378,8 +436,12 @@ class Pipeline:
             self._ladder = build_ladder(cap, up=self._control.ladder_up,
                                         down=self._control.ladder_down)
             chain_cap = self._ladder[-1]
+        # event-time sub-toggle resolved at CONSTRUCTION (geometry-binding,
+        # the control= convention): the histograms live in operator state
+        from ..observability import event_time_enabled
         self.chain = CompiledChain(chain_ops, source.payload_spec(),
-                                   batch_capacity=chain_cap)
+                                   batch_capacity=chain_cap,
+                                   event_time=event_time_enabled(monitoring))
         #: None = consult WF_MONITORING; True/str/MonitoringConfig = enable
         #: (see observability.MonitoringConfig.resolve); resolved lazily so an
         #: env change between construction and run() is honored

@@ -103,8 +103,14 @@ class ThreadedPipeline:
         spec = source.payload_spec()
         self.chains: List[CompiledChain] = []
         cap = getattr(source, "out_capacity", lambda b: b)(batch_size)
+        # event-time sub-toggle (WF_MONITORING/WF_MONITORING_EVENT_TIME —
+        # this driver has no monitoring= kwarg): geometry-binding, resolved
+        # once before the segment chains build their operator states
+        from ..observability import event_time_enabled
+        et = event_time_enabled(None)
         for seg in segments:
-            chain = CompiledChain(list(seg), spec, batch_capacity=cap)
+            chain = CompiledChain(list(seg), spec, batch_capacity=cap,
+                                  event_time=et)
             spec = chain.out_spec
             for op in chain.ops:
                 cap = op.out_capacity(cap)
