@@ -241,6 +241,43 @@ def _bench_loop(step, states, n_steps, reps: int = 1):
     return sorted(times)[len(times) // 2], states
 
 
+def _health_compile_stats(steps: int = 8, batch: int = 4096) -> dict:
+    """Hermetic compile-ledger stats for the trend (device-free, the
+    ``cost`` convention): drive a small YSB chain through the real
+    ``CompiledChain.push`` path with a private health ledger active and
+    report compiles per driven step — the dispatch-amortization /
+    trace-stability number ``bench_trend.py`` renders as its
+    compiles/step column, moving even in tunnel-down rounds.  An
+    unexpected-retrace count other than zero here means a warm executable
+    recompiled mid-drive — a perf regression no throughput row would
+    attribute."""
+    from windflow_tpu.benchmarks import ysb
+    from windflow_tpu.observability import device_health as _dh
+    from windflow_tpu.runtime.pipeline import CompiledChain
+    panes_per_batch = max(batch // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN), 1) + 1
+    src = ysb.make_source(total=(steps + 1) * batch)
+    ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
+                       max_wins=panes_per_batch + 64)
+    prev = _dh.get_active()
+    led = _dh.HealthLedger(cost_analysis=False)   # counters only: fast
+    _dh.set_active(led)
+    try:
+        chain = CompiledChain(ops, src.payload_spec(), batch_capacity=batch,
+                              event_time=False)
+        n = 0
+        for b in src.batches(batch):
+            if n >= steps:
+                break
+            chain.push(b)
+            n += 1
+    finally:
+        _dh.set_active(prev)
+    return {"compiles": led.traces,
+            "retraces_unexpected": led.retraces_unexpected,
+            "steps": n,
+            "compiles_per_step": round(led.traces / max(n, 1), 4)}
+
+
 def bench_ysb():
     import jax
     import jax.numpy as jnp
@@ -1170,6 +1207,13 @@ def main():
         # these columns; the hermetic perf gate pins the same numbers)
         headline["cost"] = {"flops_per_step": ysb_roof["flops_per_step"],
                             "bytes_per_step": ysb_roof["bytes_per_step"]}
+    try:
+        # compile-ledger column (device-free, like `cost`): compiles per
+        # driven step through the real push path + unexpected retraces
+        headline["health"] = _health_compile_stats()
+    except Exception as e:  # noqa: BLE001 — a trend column must never
+        #                     block the headline
+        print(f"health compile stats unavailable: {e}", file=sys.stderr)
     record_headline(headline)
     try:
         _secondary_benches(ysb_tps, ysb_step_s, headline)

@@ -72,7 +72,7 @@ def bench_rows(rounds, threshold: float):
         row = {"round": n, "rc": rc, "value": None, "unit": "",
                "vs_baseline": None, "stale": False, "status": "",
                "note": "", "flops_per_step": None, "bytes_per_step": None,
-               "launches_per_step": None}
+               "launches_per_step": None, "compiles_per_step": None}
         if parsed is None or rc not in (0, None):
             # rc=1/parsed=null rounds MUST surface — a silent skip would
             # render the failed round as "nothing happened"
@@ -85,6 +85,7 @@ def bench_rows(rounds, threshold: float):
         value = parsed.get("value")
         cost = parsed.get("cost") or {}
         dispatch = parsed.get("dispatch") or {}
+        health = parsed.get("health") or {}
         row.update(value=value, unit=parsed.get("unit", ""),
                    vs_baseline=parsed.get("vs_baseline"),
                    stale=bool(parsed.get("stale")),
@@ -97,7 +98,12 @@ def bench_rows(rounds, threshold: float):
                    # scan dispatch (bench.py headline `dispatch`): host
                    # executable launches per batch through the real driver —
                    # 1.0 per-batch, ~1/K fused (bench_dispatch)
-                   launches_per_step=dispatch.get("launches_per_step"))
+                   launches_per_step=dispatch.get("launches_per_step"),
+                   # compile ledger (bench.py headline `health`, PR 11's
+                   # hermetic device_health ledger): jit traces per driven
+                   # step through CompiledChain.push — trace stability
+                   # moves every round, tunnel up or down
+                   compiles_per_step=health.get("compiles_per_step"))
         if value is None:
             row["status"] = "FAILED"
             row["note"] = "parsed record without a value"
@@ -221,8 +227,9 @@ def render_markdown(bench, multichip, threshold: float,
     lines.append("## Single-chip (`BENCH_r*.json`, `parsed` metric)")
     lines.append("")
     lines.append("| round | status | value | unit | vs baseline "
-                 "| Mflop/step | MB/step | launches/step | note |")
-    lines.append("|---|---|---|---|---|---|---|---|---|")
+                 "| Mflop/step | MB/step | launches/step | compiles/step "
+                 "| note |")
+    lines.append("|---|---|---|---|---|---|---|---|---|---|")
     for r in bench:
         mflop = (f"{r['flops_per_step'] / 1e6:.2f}"
                  if r.get("flops_per_step") else "—")
@@ -230,12 +237,15 @@ def render_markdown(bench, multichip, threshold: float,
               if r.get("bytes_per_step") else "—")
         lps = (f"{r['launches_per_step']:g}"
                if r.get("launches_per_step") else "—")
+        cps = (f"{r['compiles_per_step']:g}"
+               if r.get("compiles_per_step") else "—")
         lines.append(f"| r{r['round']:02d} | {r['status']} "
                      f"| {_fmt(r['value'])} | {r['unit'] or '—'} "
                      f"| {_fmt(r['vs_baseline'])} "
-                     f"| {mflop} | {mb} | {lps} | {_cell(r['note'] or '')} |")
+                     f"| {mflop} | {mb} | {lps} | {cps} "
+                     f"| {_cell(r['note'] or '')} |")
     if not bench:
-        lines.append("| — | — | — | — | — | — | — | — "
+        lines.append("| — | — | — | — | — | — | — | — | — "
                      "| no BENCH_r*.json found |")
     if nexmark is not None:
         lines += render_nexmark(*nexmark)

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# ci.sh — the whole local gate in one command, one combined exit code:
+#
+#   wf_lint (framework-invariant linter, exit 0/1/2)
+#     -> wf_perfgate (hermetic AOT cost pins + proxy microbenches, 0/1/2)
+#     -> tier-1 tests (the ROADMAP.md verify command)
+#
+# Every step runs even when an earlier one failed (the full picture in one
+# pass); the exit code is nonzero iff ANY step failed. Usage:
+#
+#   scripts/ci.sh              # everything
+#   scripts/ci.sh --fast      # lint + perfgate only (seconds, no pytest)
+set -u
+cd "$(dirname "$0")/.."
+
+overall=0
+run_step() {
+    local name="$1"; shift
+    echo "==================== ${name} ===================="
+    "$@"
+    local rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "ci: ${name} FAILED (rc=${rc})" >&2
+        overall=1
+    else
+        echo "ci: ${name} ok"
+    fi
+}
+
+run_step "wf_lint" python scripts/wf_lint.py
+run_step "perf gate" env JAX_PLATFORMS=cpu python scripts/wf_perfgate.py
+
+if [ "${1:-}" != "--fast" ]; then
+    # the ROADMAP.md tier-1 verify command (minus the log plumbing)
+    run_step "tier-1 tests" env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider
+fi
+
+if [ $overall -ne 0 ]; then
+    echo "ci: FAILED" >&2
+else
+    echo "ci: all green"
+fi
+exit $overall

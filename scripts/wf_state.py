@@ -32,6 +32,7 @@ import importlib.util
 import json
 import os
 import sys
+import types
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -48,39 +49,26 @@ def _load_event_time():
     return mod
 
 
-def _load_snapshots(mon_dir):
-    """(latest snapshot, full time series) from a monitoring directory."""
-    series = []
-    jl = os.path.join(mon_dir, "snapshots.jsonl")
-    if os.path.exists(jl):
-        with open(jl) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    series.append(json.loads(line))
-    latest = None
-    sj = os.path.join(mon_dir, "snapshot.json")
-    if os.path.exists(sj):
-        with open(sj) as f:
-            latest = json.load(f)
-    elif series:
-        latest = series[-1]
-    if latest is None:
-        raise FileNotFoundError(
-            f"no snapshot.json / snapshots.jsonl under {mon_dir!r}")
-    return latest, series
-
-
-def _load_journal(mon_dir):
-    path = os.path.join(mon_dir, "events.jsonl")
-    out = []
-    if os.path.exists(path):
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    out.append(json.loads(line))
-    return out
+def _load_device_health():
+    """Load observability/device_health.py by file path — THE shared
+    snapshot/journal loader (+ fleet merge) of wf_state/wf_trace/wf_health,
+    so the three CLIs can never drift on torn-line handling."""
+    obs = os.path.join(REPO, "windflow_tpu", "observability")
+    pkg = sys.modules.get("wf_obs")
+    if pkg is None:
+        pkg = types.ModuleType("wf_obs")
+        pkg.__path__ = [obs]
+        sys.modules["wf_obs"] = pkg
+    for name in ("journal", "device_health"):
+        if f"wf_obs.{name}" in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            f"wf_obs.{name}", os.path.join(obs, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"wf_obs.{name}"] = mod
+        spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return sys.modules["wf_obs.device_health"]
 
 
 # ------------------------------------------------------------ report pieces
@@ -219,6 +207,12 @@ def main(argv=None) -> int:
     ap.add_argument("--monitoring-dir", default="wf_monitoring",
                     help="monitoring output directory (snapshots.jsonl + "
                          "snapshot.json + events.jsonl)")
+    ap.add_argument("--merge", nargs="+", default=None, metavar="DIR",
+                    help="merge N per-host monitoring directories (or "
+                         "snapshots.jsonl paths) into one fleet view — "
+                         "counters summed, watermark frontier min'd, "
+                         "occupancy/pressure max'd (device_health."
+                         "merge_snapshots) — instead of --monitoring-dir")
     ap.add_argument("--q", type=float, default=0.99,
                     help="lateness quantile recommend_delay must cover "
                          "(default 0.99; 1.0 = every recorded straggler)")
@@ -237,26 +231,32 @@ def main(argv=None) -> int:
         return 2
     try:
         et = _load_event_time()
+        dh = _load_device_health()
     except (OSError, ImportError, SyntaxError) as e:
-        # the 0/2 contract covers the bucket-math module too: a box the
+        # the 0/2 contract covers the helper modules too: a box the
         # artifacts were copied to without the windflow_tpu tree beside
         # this script gets the guidance, not a traceback
-        print(f"wf_state: cannot load observability/event_time.py from "
+        print(f"wf_state: cannot load observability helpers from "
               f"{REPO!r}: {type(e).__name__}: {e}\n"
               f"(keep scripts/wf_state.py next to its windflow_tpu tree — "
-              f"it reuses the lateness bucket math by file path)",
+              f"it reuses the lateness bucket math and the snapshot loader "
+              f"by file path)",
               file=sys.stderr)
         return 2
     try:
-        snap, series = _load_snapshots(args.monitoring_dir)
+        if args.merge:
+            snap, series, journal = dh.merge_monitoring_dirs(args.merge)
+        else:
+            snap, series = dh.load_snapshots(args.monitoring_dir)
+            journal = dh.load_journal(args.monitoring_dir)
     except (OSError, ValueError, json.JSONDecodeError) as e:
+        where = args.merge or args.monitoring_dir
         print(f"wf_state: cannot load snapshots from "
-              f"{args.monitoring_dir!r}: {type(e).__name__}: {e}\n"
+              f"{where!r}: {type(e).__name__}: {e}\n"
               f"(run with WF_MONITORING=1 WF_MONITORING_EVENT_TIME=1, or "
               f"monitoring=MonitoringConfig(event_time=True))",
               file=sys.stderr)
         return 2
-    journal = _load_journal(args.monitoring_dir)
 
     lat_lines, lat_data = lateness_report(snap, journal, et, args.q)
     if args.json:
@@ -265,6 +265,9 @@ def main(argv=None) -> int:
                "operators": {name: sec for name, sec in _et_rows(snap)},
                "recommendations": lat_data,
                "snapshots": len(series)}
+        if snap.get("hosts"):
+            out["hosts"] = snap["hosts"]
+            out["merged_from"] = snap.get("merged_from")
         print(json.dumps(out, indent=1, sort_keys=True))
         return 0
     blocks = []
@@ -274,7 +277,10 @@ def main(argv=None) -> int:
         blocks.append(pressure_trends(snap, series))
     if args.report in ("all", "lateness"):
         blocks.append(lat_lines)
-    print(f"wf_state: {args.monitoring_dir!r} — graph "
+    head = (f"wf_state: merged {snap.get('merged_from')} host(s): "
+            + ", ".join(h.get("host", "?") for h in snap.get("hosts", []))
+            if args.merge else f"wf_state: {args.monitoring_dir!r}")
+    print(f"{head} — graph "
           f"{snap.get('graph', '?')!r}, {len(series)} snapshot(s), "
           f"{len(journal)} journal event(s)")
     for b in blocks:

@@ -37,14 +37,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _load_tracing():
-    """Load observability/tracing.py (and the journal module its relative
-    import names) by file path under a synthetic package — no windflow_tpu
-    package import, no JAX."""
+    """Load observability/tracing.py (and the journal + device_health
+    modules: the relative import, and THE shared snapshot loader of
+    wf_state/wf_trace/wf_health) by file path under a synthetic package —
+    no windflow_tpu package import, no JAX."""
     obs = os.path.join(REPO, "windflow_tpu", "observability")
-    pkg = types.ModuleType("wf_obs")
-    pkg.__path__ = [obs]
-    sys.modules["wf_obs"] = pkg
-    for name in ("journal", "tracing"):
+    pkg = sys.modules.get("wf_obs")
+    if pkg is None:
+        pkg = types.ModuleType("wf_obs")
+        pkg.__path__ = [obs]
+        sys.modules["wf_obs"] = pkg
+    for name in ("journal", "device_health", "tracing"):
+        if f"wf_obs.{name}" in sys.modules:
+            continue
         spec = importlib.util.spec_from_file_location(
             f"wf_obs.{name}", os.path.join(obs, f"{name}.py"))
         mod = importlib.util.module_from_spec(spec)
@@ -74,7 +79,18 @@ def main(argv=None) -> int:
                     help="slowest batches to drill into (default 5)")
     args = ap.parse_args(argv)
 
-    tracing = _load_tracing()
+    try:
+        tracing = _load_tracing()
+    except (OSError, ImportError, SyntaxError) as e:
+        # the 0/2 contract covers the helper modules too (the wf_state.py
+        # convention): an artifacts-only box without the windflow_tpu tree
+        # beside this script gets guidance, not a traceback
+        print(f"wf_trace: cannot load observability helpers from "
+              f"{REPO!r}: {type(e).__name__}: {e}\n"
+              f"(keep scripts/wf_trace.py next to its windflow_tpu tree — "
+              f"it loads tracing.py/journal.py/device_health.py by file "
+              f"path)", file=sys.stderr)
+        return 2
     try:
         records, meta = tracing.load_flight(args.trace_dir)
     except (OSError, ValueError, json.JSONDecodeError) as e:
@@ -89,15 +105,14 @@ def main(argv=None) -> int:
         mon_dir = "wf_monitoring"
     journal_events, snapshot = [], None
     if mon_dir:
-        ev_path = os.path.join(mon_dir, "events.jsonl")
-        if os.path.exists(ev_path):
-            # the journal module was loaded alongside tracing — ONE parser
-            journal_events = sys.modules["wf_obs.journal"].read_journal(
-                ev_path)
-        snap_path = os.path.join(mon_dir, "snapshot.json")
-        if os.path.exists(snap_path):
-            with open(snap_path) as f:
-                snapshot = json.load(f)
+        # the shared loader (device_health.py, loaded alongside tracing):
+        # torn-tolerant, one parser for all three CLIs
+        dh = sys.modules["wf_obs.device_health"]
+        journal_events = dh.load_journal(mon_dir)
+        try:
+            snapshot, _series = dh.load_snapshots(mon_dir)
+        except (OSError, ValueError):
+            snapshot = None                # trace-only run: no snapshots
 
     out_path = args.out or os.path.join(args.trace_dir, "trace.json")
     trace = tracing.to_chrome_trace(records, journal_events, meta)

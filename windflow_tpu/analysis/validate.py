@@ -63,6 +63,12 @@ WF112  error     session-window gap under a CB-only source: every
                  time (ts defaults to the arrival index), so the gap —
                  defined in event-time units — fires on arrival
                  positions instead
+WF113  error     runtime-health config the run cannot honor: the
+                 ``WF_MONITORING_HEALTH`` sub-toggle set while
+                 monitoring itself resolves off (the ledger could
+                 never activate — the run would silently produce no
+                 health artifacts), or an illegal
+                 ``WF_HEALTH_SAMPLE`` (non-integer / < 1)
 WF110  warn/err  scan dispatch (K > 1) combined with a configuration
                  the fused launch cannot honor: an unresolvable
                  ``dispatch=``/``WF_DISPATCH`` (error);
@@ -403,6 +409,37 @@ def _check_trace(report, trace, stored_arg, supervised: bool) -> None:
                  "PositionBucket")
 
 
+def _check_health(report, stored_monitoring) -> None:
+    """WF113: the runtime-health mirror of WF108 — resolve the monitoring
+    config exactly as the driver will (the object's stored ``monitoring=``
+    argument / ``WF_MONITORING``) and reject health configurations the run
+    cannot honor before it starts."""
+    import os
+    from ..observability import MonitoringConfig
+    try:
+        cfg = MonitoringConfig.resolve(stored_monitoring)
+    except (ValueError, TypeError) as e:
+        report.add(
+            "WF113", "error", "monitoring.health",
+            f"monitoring/health config does not resolve: "
+            f"{type(e).__name__}: {e}",
+            hint="WF_HEALTH_SAMPLE must be a positive integer "
+                 "(MonitoringConfig.health_sample >= 1)")
+        return
+    if cfg is None:
+        env = os.environ.get("WF_MONITORING_HEALTH", "")
+        if env not in ("", "0"):
+            report.add(
+                "WF113", "error", "monitoring.health",
+                "WF_MONITORING_HEALTH is set but monitoring itself resolves "
+                "off — the health ledger can never activate, so the run "
+                "would silently produce no HBM/compile/device-time "
+                "artifacts",
+                hint="enable monitoring alongside the sub-toggle: "
+                     "WF_MONITORING=1 (or monitoring=/MonitoringConfig("
+                     "health=True) on the driver)")
+
+
 def _check_kernel_records(report) -> None:
     """WF109: compare every kernel-impl choice the registry recorded at
     trace time against what it would resolve to NOW (env/tuning-cache as of
@@ -665,6 +702,7 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _check_faults(report, faults, "supervised" if supervised else "pipeline")
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(p, "_trace_arg", None), supervised)
+    _check_health(report, getattr(p, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(p, "_dispatch_arg", None), cfg,
                     trace, getattr(p, "_trace_arg", None), supervised)
 
@@ -684,6 +722,7 @@ def _validate_supervised(report, sp, faults, control, trace=None,
                   else getattr(sp, "_faults_arg", None), "supervised")
     _check_admission(report, cfg, True, "control.admission")
     _check_trace(report, trace, getattr(sp, "_trace_arg", None), True)
+    _check_health(report, getattr(sp, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(sp, "_dispatch_arg", None),
                     cfg, trace, getattr(sp, "_trace_arg", None), True)
 
@@ -715,6 +754,7 @@ def _validate_threaded(report, tp, faults, control, supervised,
                   else getattr(tp, "_faults_arg", None), "threaded")
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(tp, "_trace_arg", None), supervised)
+    _check_health(report, getattr(tp, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(tp, "_dispatch_arg", None),
                     cfg, trace, getattr(tp, "_trace_arg", None), supervised,
                     edges=edges)
@@ -814,6 +854,7 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_faults(report, faults, driver)
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(g, "_trace_arg", None), supervised)
+    _check_health(report, getattr(g, "_monitoring_arg", None))
     dedges = None
     if threaded:
         try:

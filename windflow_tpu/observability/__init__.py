@@ -36,6 +36,7 @@ from typing import Optional, Union
 
 from .journal import EventJournal, read_journal, set_active as set_journal
 from .metrics import LogHistogram, MetricsRegistry
+from . import device_health
 from . import event_time
 from .names import (CONTROL_COUNTERS, CONTROL_GAUGES, JOURNAL_EVENTS,
                     RECOVERY_COUNTERS, TRACE_RECORD_KINDS, TRACE_STAGES)
@@ -50,6 +51,7 @@ __all__ = [
     "LogHistogram", "MetricsRegistry", "Reporter", "EventJournal",
     "MonitoringConfig", "Monitor", "journal", "read_journal", "set_journal",
     "TraceConfig", "Tracer", "tracing", "event_time", "event_time_enabled",
+    "device_health",
     "topology_dot", "topology_json", "graph_topology_dot",
     "graph_topology_json", "pipeline_topology_dot", "pipeline_topology_json",
 ]
@@ -85,6 +87,32 @@ class MonitoringConfig:
     #: never the results (chaos-pinned byte-identical).  Env override:
     #: ``WF_MONITORING_EVENT_TIME`` (``''``/``'0'`` off, anything else on).
     event_time: bool = False
+    #: runtime-health sub-toggle (off by default): the HBM memory ledger
+    #: (per-device ``memory_stats``/live-buffer gauges, per-operator state
+    #: footprints, executable footprints, ``windflow_hbm_headroom_bytes``),
+    #: the compile/retrace ledger (every chain-program trace journaled with
+    #: cause/cache-key/duration/AOT cost + the unexpected-retrace
+    #: detector), and sampled device-time attribution with the per-stage
+    #: dispatch-bound classifier (``observability/device_health.py``).
+    #: Purely host-side — unlike ``event_time`` this is NOT geometry-
+    #: binding: compiled programs, operator state, and the perf-gate cost
+    #: pins are byte-for-byte unchanged either way (the ledger hooks in
+    #: the jitted step bodies execute at trace time only and contribute no
+    #: equations).  Env override: ``WF_MONITORING_HEALTH`` (``''``/``'0'``
+    #: off, anything else on); analyze with ``scripts/wf_health.py``.
+    health: bool = False
+    #: record the device-time split on every Nth SAMPLED service point
+    #: (the pushes CompiledChain already times to completion); must be
+    #: >= 1 when health is on — ``WF_HEALTH_SAMPLE`` overrides, the
+    #: validator surfaces an illegal value as WF113 before the run
+    health_sample: int = 1
+    #: AOT-lower each freshly compiled program once more so its ``compile``
+    #: journal record carries cost-analysis flops/bytes + the executable
+    #: footprint.  That second lowering+compile runs inline in the driver
+    #: loop, roughly doubling compile latency — turn it off for
+    #: compile-heavy monitored runs (capacity/K ladders, autotune sweeps)
+    #: where the cause/key/duration columns are enough
+    health_cost_analysis: bool = True
 
     def should_sample_e2e(self, n: int) -> bool:
         """THE e2e sampling policy, shared by every driver: every Nth source
@@ -120,6 +148,17 @@ class MonitoringConfig:
         et = os.environ.get("WF_MONITORING_EVENT_TIME")
         if et is not None and et != "":
             cfg = dataclasses.replace(cfg, event_time=et != "0")
+        hv = os.environ.get("WF_MONITORING_HEALTH")
+        if hv is not None and hv != "":
+            cfg = dataclasses.replace(cfg, health=hv != "0")
+        hs = os.environ.get("WF_HEALTH_SAMPLE", "")
+        if hs:
+            cfg = dataclasses.replace(cfg, health_sample=int(hs))
+        if cfg.health and int(cfg.health_sample) < 1:
+            raise ValueError(
+                f"health_sample/WF_HEALTH_SAMPLE must be >= 1, got "
+                f"{cfg.health_sample} (the validator reports this as WF113 "
+                f"before the run)")
         return cfg
 
 
@@ -143,7 +182,16 @@ class Monitor:
     def __init__(self, config: MonitoringConfig, name: str = "pipegraph"):
         self.config = config
         os.makedirs(config.out_dir, exist_ok=True)
-        self.registry = MetricsRegistry(name, event_time=config.event_time)
+        #: runtime-health ledger (MonitoringConfig.health): activated for
+        #: the run like the journal — CompiledChain/registry call sites
+        #: reach it through device_health's module-level active hook
+        self.health: Optional[device_health.HealthLedger] = (
+            device_health.HealthLedger(
+                sample_every=config.health_sample,
+                cost_analysis=config.health_cost_analysis)
+            if config.health else None)
+        self.registry = MetricsRegistry(name, event_time=config.event_time,
+                                        health_ledger=self.health)
         self.journal: Optional[EventJournal] = None
         if config.journal:
             self.journal = EventJournal(
@@ -159,6 +207,8 @@ class Monitor:
             set_journal(self.journal)
             self.journal.event("monitoring_start", graph=self.registry.name,
                                interval_s=self.config.interval_s)
+        if self.health is not None:
+            device_health.set_active(self.health)
         self.reporter.start()
 
     def finish(self, target=None) -> None:
@@ -177,6 +227,9 @@ class Monitor:
                                        "topology.json"), "w") as f:
                     _json.dump(topology_json(target, snap), f, indent=1)
         finally:
+            if (self.health is not None
+                    and device_health.get_active() is self.health):
+                device_health.set_active(None)
             if self.journal is not None:
                 self.journal.event("monitoring_end",
                                    graph=self.registry.name)

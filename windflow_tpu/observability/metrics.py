@@ -168,6 +168,43 @@ def _check_event_time_names() -> None:
 
 _check_event_time_names()
 
+#: HELP text per runtime-health gauge — checked against
+#: ``names.py::HEALTH_GAUGES`` at import (the event-time lockstep
+#: discipline): only registered names can render.  The ``hbm_*`` family
+#: renders as ``windflow_hbm_<name>`` per device; the rest as
+#: ``windflow_health_<name>``.
+_HEALTH_HELP = {
+    "hbm_headroom_bytes": "device memory limit minus bytes in use — the "
+                          "tiered-state eviction signal",
+    "hbm_bytes_in_use": "device memory bytes in use",
+    "hbm_bytes_limit": "device memory limit (allocatable bytes)",
+    "live_buffer_bytes": "process-wide live jax array bytes",
+    "live_buffer_count": "process-wide live jax array count",
+    "state_bytes": "operator state-pytree footprint (bytes)",
+    "compiles": "chain program traces observed (compile ledger)",
+    "retraces": "re-traces under a NEW shape/dtype signature "
+                "(capacity switch, weak-type drift)",
+    "retraces_unexpected": "re-traces of a warm executable under an "
+                           "already-traced signature",
+    "compile_seconds": "total seconds spent in journaled compiles",
+    "device_ms": "sampled device execution time per stage (ms)",
+    "dispatch_ms": "sampled host dispatch overhead per stage (ms)",
+    "dispatch_ratio": "host dispatch / device time per stage — >= 0.5 "
+                      "names a fusion candidate",
+}
+
+
+def _check_health_names() -> None:
+    from .names import HEALTH_GAUGES
+    if set(_HEALTH_HELP) != set(HEALTH_GAUGES):
+        raise RuntimeError(
+            f"metrics.py health exposition drifted from "
+            f"names.py::HEALTH_GAUGES: "
+            f"{set(_HEALTH_HELP) ^ set(HEALTH_GAUGES)}")
+
+
+_check_health_names()
+
 
 def _recovery_counters() -> Dict[str, float]:
     """Process-wide supervision counters (lazy import: runtime.faults imports
@@ -202,8 +239,19 @@ class MetricsRegistry:
     of TB window states (a tiny D2H read — monitoring-path only).
     """
 
-    def __init__(self, name: str = "pipegraph", event_time: bool = False):
+    def __init__(self, name: str = "pipegraph", event_time: bool = False,
+                 health_ledger=None, health: Optional[bool] = None):
         self.name = name
+        #: runtime-health observability (MonitoringConfig.health): snapshots
+        #: grow a graph-level ``health`` section — per-device memory gauges
+        #: + headroom, per-operator state-pytree footprints, the compile/
+        #: retrace ledger, sampled device-time attribution with the
+        #: dispatch-bound classifier — and the Prometheus exposition the
+        #: ``windflow_hbm_*``/``windflow_health_*`` gauges.  Host-side
+        #: metadata reads only (shapes, memory_stats) — never a device sync.
+        self._health_ledger = health_ledger
+        self.health = bool(health_ledger is not None if health is None
+                           else health)
         #: event-time observability (MonitoringConfig.event_time): snapshot
         #: rows grow per-operator ``event_time`` sections (watermarks, state
         #: occupancy, lateness histograms), the snapshot a graph-level
@@ -468,7 +516,54 @@ class MetricsRegistry:
             et = self._event_time_section(et_secs)
             if et:
                 snap["event_time"] = et
+        if self.health:
+            snap["health"] = self._health_section()
         return snap
+
+    def _iter_health_chains(self):
+        """Every live CompiledChain visible to this registry (deduped) —
+        the state-footprint walk of the health section."""
+        seen = set()
+        chains = []
+        for g in self._graphs:
+            for mp in g._all_pipes():
+                chains.append(mp._chain)
+        for p in self._pipelines:
+            chains.append(getattr(p, "chain", None))
+        for _, ch in self._chains:
+            chains.append(ch)
+        for ch in chains:
+            if ch is not None and id(ch) not in seen:
+                seen.add(id(ch))
+                yield ch
+
+    def _health_section(self) -> dict:
+        """The runtime-health ledger, snapshot-shaped: HBM devices +
+        headroom, live-buffer totals, per-operator state footprints (static
+        shape metadata — no device sync), and — when a ledger is active —
+        the compile/retrace counters, executable footprints, and the
+        sampled device-time attribution with its dispatch-bound
+        classifier."""
+        from . import device_health as _dh
+        sec: dict = {"devices": _dh.device_memory()}
+        sec.update(_dh.live_buffer_stats())
+        state_bytes: Dict[str, int] = {}
+        for ch in self._iter_health_chains():
+            try:
+                fp = ch.state_footprints()
+            except Exception:   # noqa: BLE001 — never kill a snapshot
+                continue
+            for op_name, nbytes in fp.items():
+                state_bytes[op_name] = state_bytes.get(op_name, 0) + nbytes
+        if state_bytes:
+            sec["state_bytes"] = state_bytes
+        led = self._health_ledger or _dh.get_active()
+        if led is not None:
+            sec.update(led.snapshot_section())
+        risky = _dh.headroom_risks(sec["devices"])
+        if risky:
+            sec["headroom_risk"] = risky
+        return sec
 
     def _event_time_section(self, et_secs: Dict[int, dict]) -> dict:
         """Graph-level watermark propagation map: the min-watermark frontier
@@ -523,6 +618,59 @@ class MetricsRegistry:
         return out
 
     # -- Prometheus text exposition ----------------------------------------------------
+
+    @staticmethod
+    def _prometheus_health(snap: dict, lines: List[str], esc) -> None:
+        """``windflow_hbm_*`` (per device) + ``windflow_health_*`` gauges
+        from the snapshot's health section.  Only the names registered in
+        ``names.py::HEALTH_GAUGES`` render (the import-time lockstep check
+        above); absent values (e.g. ``memory_stats`` on a CPU backend)
+        simply do not render."""
+        sec = snap.get("health")
+        if not sec:
+            return
+        g = snap["graph"]
+        typed = set()
+
+        def head(metric, name):
+            if metric not in typed:
+                typed.add(metric)
+                lines.append(f"# HELP {metric} {_HEALTH_HELP[name]}")
+                lines.append(f"# TYPE {metric} gauge")
+
+        for d in sec.get("devices", []):
+            lab = f'graph="{esc(g)}",device="{esc(d.get("device", "?"))}"'
+            for name in ("hbm_bytes_in_use", "hbm_bytes_limit",
+                         "hbm_headroom_bytes"):
+                v = d.get(name[4:])      # row keys drop the hbm_ prefix
+                if v is not None:
+                    head(f"windflow_{name}", name)
+                    lines.append(f'windflow_{name}{{{lab}}} {v}')
+        glab = f'graph="{esc(g)}"'
+        for name in ("live_buffer_bytes", "live_buffer_count"):
+            if sec.get(name) is not None:
+                head(f"windflow_health_{name}", name)
+                lines.append(f'windflow_health_{name}{{{glab}}} {sec[name]}')
+        for op_name, nbytes in sorted((sec.get("state_bytes") or {}).items()):
+            head("windflow_health_state_bytes", "state_bytes")
+            lines.append(f'windflow_health_state_bytes{{{glab},'
+                         f'operator="{esc(op_name)}"}} {nbytes}')
+        comp = sec.get("compile") or {}
+        for name, key in (("compiles", "compiles"), ("retraces", "retraces"),
+                          ("retraces_unexpected", "retraces_unexpected"),
+                          ("compile_seconds", "compile_s_total")):
+            if comp.get(key) is not None:
+                head(f"windflow_health_{name}", name)
+                lines.append(f'windflow_health_{name}{{{glab}}} {comp[key]}')
+        for label, row in sorted((sec.get("device_time") or {}).items()):
+            slab = f'{glab},stage="{esc(label)}"'
+            for name, key in (("device_ms", "device_ms"),
+                              ("dispatch_ms", "dispatch_ms"),
+                              ("dispatch_ratio", "dispatch_ratio")):
+                if row.get(key) is not None:
+                    head(f"windflow_health_{name}", name)
+                    lines.append(f'windflow_health_{name}{{{slab}}} '
+                                 f'{row[key]}')
 
     @staticmethod
     def _prometheus_event_time(snap: dict, lines: List[str], esc) -> None:
@@ -639,6 +787,7 @@ class MetricsRegistry:
                     f'windflow_stage_{c}{suffix}{{graph="{esc(g)}",'
                     f'operator="{esc(row["name"])}"}} {row["counters"][c]}')
         self._prometheus_event_time(snap, lines, esc)
+        self._prometheus_health(snap, lines, esc)
         lines.append("# TYPE windflow_queue_depth gauge")
         for edge, depth in snap["queues"].items():
             lines.append(f'windflow_queue_depth{{graph="{esc(g)}",'

@@ -54,6 +54,15 @@ JOURNAL_EVENTS = (
     # (tid/pos) of the sampled batch the readback rode, so wf_trace.py /
     # wf_state.py join drops to traced batches
     "lateness_drop",
+    # runtime health ledger (observability/device_health.py, health
+    # monitoring only): "compile" = one jit trace of a CompiledChain
+    # step/scan program (cause, cache key, compile duration, AOT cost
+    # flops/bytes); "retrace_unexpected" = the live retrace detector — a
+    # warm executable re-traced under an ALREADY-TRACED signature (jit
+    # cache eviction/clear, the WF102/WF109 hazard caught at runtime);
+    # "kernel_resolve" = a per-backend kernel registry resolution
+    # (ops/registry.py) observed while the ledger was active
+    "compile", "retrace_unexpected", "kernel_resolve",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -142,6 +151,26 @@ EVENT_TIME_GAUGES = (
     "oldest_open_age", "archive_fill_pct",
     "lateness_p50", "lateness_p99",        # lateness histogram quantiles
     "min_watermark", "skew",               # graph frontier + per-edge skew
+)
+
+#: runtime-health gauges of the ``health`` snapshot section
+#: (``MonitoringConfig.health`` / ``WF_MONITORING_HEALTH``;
+#: ``metrics.py::_prometheus_health`` renders ONLY registered names — its
+#: local HELP map is checked against this tuple at import, the
+#: EVENT_TIME_GAUGES lockstep discipline).  The ``hbm_*`` family renders as
+#: ``windflow_hbm_<name>`` (per device), the rest as
+#: ``windflow_health_<name>`` (graph-/operator-/stage-labelled).
+HEALTH_GAUGES = (
+    "hbm_headroom_bytes",      # per device: bytes_limit - bytes_in_use —
+    #                            THE eviction signal for tiered state
+    "hbm_bytes_in_use", "hbm_bytes_limit",
+    "live_buffer_bytes", "live_buffer_count",
+    "state_bytes",             # per operator: state-pytree footprint
+    "compiles", "retraces", "retraces_unexpected",  # compile ledger totals
+    "compile_seconds",
+    "device_ms", "dispatch_ms",                     # per stage label
+    "dispatch_ratio",          # host dispatch / device time — >= 0.5 names
+    #                            a fusion candidate (dispatch-bound edge)
 )
 
 #: kernel families selectable through the per-backend kernel registry

@@ -24,10 +24,27 @@ from .metrics import MetricsRegistry
 
 
 def _atomic_write(path: str, data: str) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    """Write-then-rename (the checkpoint.py convention): a reader — the
+    stdlib CLIs wf_state/wf_trace/wf_health poll these files while the run
+    is live — can NEVER observe a torn snapshot.json / metrics.prom: either
+    the old complete file or the new complete file.  The tmp name carries
+    pid + thread id so a reporter tick racing a final ``stop()`` emit (two
+    writers, one path) cannot truncate each other's in-flight tmp; flush +
+    fsync before the rename so the replace publishes complete bytes, not an
+    empty inode, even across a crash."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):            # failed mid-write: no debris
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 class Reporter:

@@ -19,7 +19,8 @@ from __future__ import annotations
 from typing import Optional
 
 
-def _op_info(op, rates: Optional[dict] = None) -> dict:
+def _op_info(op, rates: Optional[dict] = None,
+             state_bytes: Optional[dict] = None) -> dict:
     info = {
         "name": op.getName(),
         "routing": op.getRoutingMode().name,
@@ -30,6 +31,10 @@ def _op_info(op, rates: Optional[dict] = None) -> dict:
         r = rates[op.getName()]
         info["rate_in_tps"] = r.get("rate_in_tps")
         info["rate_out_tps"] = r.get("rate_out_tps")
+    if state_bytes and op.getName() in state_bytes:
+        # HBM memory ledger (health monitoring): the operator's state-
+        # pytree footprint, so the topology names WHERE device memory sits
+        info["state_bytes"] = state_bytes[op.getName()]
     return info
 
 
@@ -62,6 +67,8 @@ def graph_topology_json(graph, snapshot: Optional[dict] = None) -> dict:
     # it over the SAME edge-label enumeration the threaded driver rings use
     skews = ((snapshot or {}).get("event_time") or {}).get("edge_skew_ts",
                                                            {})
+    health = (snapshot or {}).get("health") or {}
+    state_bytes = health.get("state_bytes") or {}
     pipes = graph._all_pipes()
     index = {id(p): i for i, p in enumerate(pipes)}
     nodes, edges = [], []
@@ -70,7 +77,7 @@ def graph_topology_json(graph, snapshot: Optional[dict] = None) -> dict:
             "id": i,
             "source": p.source.getName() if p.source is not None else None,
             "sink": p.sink.getName() if p.sink is not None else None,
-            "ops": [_op_info(o, rates) for o in p.ops],
+            "ops": [_op_info(o, rates, state_bytes) for o in p.ops],
             "compiled": p._chain is not None,
         })
 
@@ -105,6 +112,15 @@ def graph_topology_json(graph, snapshot: Optional[dict] = None) -> dict:
         out["e2e_latency_us"] = snapshot.get("e2e_latency_us")
         if snapshot.get("event_time"):
             out["event_time"] = snapshot["event_time"]
+        if health:
+            # the runtime-health summary rides the topology export too:
+            # device headroom + the dispatch-bound stages (fusion
+            # candidates), so one artifact answers "where is the memory
+            # and which edges is the host loop throttling"
+            out["health"] = {
+                k: health[k] for k in ("devices", "headroom_risk",
+                                       "dispatch_bound", "state_bytes")
+                if health.get(k)}
     return out
 
 
@@ -163,8 +179,9 @@ def graph_topology_dot(graph, snapshot: Optional[dict] = None) -> str:
 def pipeline_topology_json(pipeline, snapshot: Optional[dict] = None) -> dict:
     """Linear Pipeline as a chain topology (source → ops → sink)."""
     rates = _rates_by_op(snapshot)
+    state_bytes = ((snapshot or {}).get("health") or {}).get("state_bytes")
     stages = [{"name": pipeline.source.getName(), "kind": "source"}]
-    stages += [dict(_op_info(o, rates), kind="operator")
+    stages += [dict(_op_info(o, rates, state_bytes), kind="operator")
                for o in pipeline.chain.ops]
     if pipeline.sink is not None:
         stages.append({"name": pipeline.sink.getName(), "kind": "sink"})
@@ -175,6 +192,12 @@ def pipeline_topology_json(pipeline, snapshot: Optional[dict] = None) -> dict:
     if snapshot:
         out["totals"] = snapshot.get("totals")
         out["e2e_latency_us"] = snapshot.get("e2e_latency_us")
+        health = snapshot.get("health") or {}
+        if health:
+            out["health"] = {
+                k: health[k] for k in ("devices", "headroom_risk",
+                                       "dispatch_bound", "state_bytes")
+                if health.get(k)}
     return out
 
 

@@ -803,6 +803,32 @@ def critical_path_report(records: List[dict],
                          f"+{e.get('n', 0)} (total {e.get('total', '?')})"
                          f"{where}")
 
+    # -- dispatch-bound classifier (health monitoring) --------------------
+    health = (snapshot or {}).get("health") or {}
+    dt = health.get("device_time") or {}
+    if dt:
+        lines.append("")
+        lines.append("device-time attribution (health ledger; sampled "
+                     "host-dispatch vs device ms per stage):")
+        bound = health.get("dispatch_bound") or {}
+        for stage, row in sorted(dt.items(),
+                                 key=lambda kv: -(kv[1].get("dispatch_ratio")
+                                                  or 0.0)):
+            ratio = row.get("dispatch_ratio")
+            flag = "  [DISPATCH-BOUND -> fusion candidate]" \
+                if stage in bound else ""
+            lines.append(
+                f"  {stage:<24} device={row.get('device_ms', 0):10.3f} ms  "
+                f"dispatch={row.get('dispatch_ms', 0):10.3f} ms  "
+                f"ratio={ratio if ratio is not None else '—'}{flag}")
+        comp = health.get("compile") or {}
+        if comp:
+            lines.append(
+                f"  compile ledger: {comp.get('compiles', 0)} compiles "
+                f"({comp.get('retraces', 0)} shape retraces, "
+                f"{comp.get('retraces_unexpected', 0)} UNEXPECTED), "
+                f"{comp.get('compile_s_total', 0)} s total")
+
     # -- per-batch phase attribution --------------------------------------
     def phases(lc) -> dict:
         t0, t1 = lc["t_ingest"], lc["t_end"]

@@ -234,9 +234,22 @@ class KernelRegistry:
         staleness comparison meaningful."""
         choice = self._select(kernel, spec_key, impl)
         if record and impl is None:
+            dk = device_kind()
             with self._lock:
                 self._records.setdefault(
-                    (kernel, spec_key, device_kind()), set()).add(choice)
+                    (kernel, spec_key, dk), set()).add(choice)
+            # runtime-health ledger (observability/device_health.py): a
+            # resolution observed while a ledger is active journals a
+            # kernel_resolve event — the compile ledger's record of WHICH
+            # impl each executable was traced with (the WF109 evidence,
+            # live). Lazy import + None check: trace-time-rare path, and
+            # this module must stay importable before observability.
+            try:
+                from ..observability import device_health as _dh
+            except ImportError:            # minimal/fixture trees
+                _dh = None
+            if _dh is not None:
+                _dh.note_kernel_resolve(kernel, spec_key, choice, device=dk)
         return choice
 
     # ------------------------------------------------------- WF109 records

@@ -270,6 +270,10 @@ class MultiPipe:
                 self.ops, self._in_payload_spec(),
                 batch_capacity=batch_capacity,
                 event_time=event_time_enabled(self.graph._monitoring_arg))
+            # health-ledger stage label = the flight-recorder pipe label, so
+            # the dispatch-bound classifier names the same edges wf_trace
+            # renders (the fusion candidates of ROADMAP item 2)
+            self._chain.label = self.graph._trace_label(self)
         return self._chain
 
 

@@ -27,6 +27,7 @@ import jax
 
 from ..basic import DEFAULT_BATCH_SIZE
 from ..batch import Batch, stack_batches, unstack_batches
+from ..observability import device_health as _dh
 from ..observability import journal as _journal
 from ..observability import tracing as _tracing
 from . import dispatch as _dispatch
@@ -75,6 +76,18 @@ def _batch_nbytes(batch: Batch) -> int:
             size *= d
         total += size * jax.numpy.dtype(getattr(leaf, "dtype", "float32")).itemsize
     return total
+
+
+def _health_sig(tree) -> str:
+    """Shape/dtype/weak-type signature of a (possibly abstract) pytree —
+    the compile-ledger cache key component.  Safe at trace time: tracers
+    expose shape/dtype/weak_type without concretization."""
+    parts = []
+    for leaf in jax.tree.leaves(tree):
+        parts.append(f"{getattr(leaf, 'shape', ())}/"
+                     f"{getattr(leaf, 'dtype', '?')}"
+                     + ("w" if getattr(leaf, "weak_type", False) else ""))
+    return ";".join(parts)
 
 
 class CompiledChain:
@@ -137,6 +150,11 @@ class CompiledChain:
         self._push_count = 0
         self._fused_count = 0       # push_many launches (scan dispatch)
         self._nbytes_cache = {}     # (from_op, in capacity) -> (in, out bytes)
+        #: stage label for the health ledger's compile + device-time
+        #: attribution (the flight-recorder stage convention): drivers
+        #: overwrite it with their real stage name — ThreadedPipeline
+        #: ``seg<i>``, PipeGraph ``pipe<i>``, Pipeline/supervised ``chain``
+        self.label = "chain"
 
     def warm(self, capacity: int) -> None:
         """Trace + compile the full-chain step for ``capacity`` WITHOUT
@@ -148,7 +166,9 @@ class CompiledChain:
         b = Batch.empty(capacity, self.specs[0])
         if self.device is not None:
             b = jax.device_put(b, self.device)
+        hl, t0c = self._health_begin("warm")
         self._step_fn(0)(tuple(self.states), b)
+        self._health_end(hl, t0c, 0, "step", b)
 
     def reset_states(self) -> None:
         """Re-initialize every operator's state (supervised replay of a chain
@@ -165,6 +185,16 @@ class CompiledChain:
     def _step_fn(self, i: int):
         if i not in self._steps:
             def step(states, batch):
+                # compile-ledger hook: this line runs at TRACE time only
+                # (host side effect, zero equations in the program — the
+                # compiled executable and the perf-gate pins are byte-for-
+                # byte identical with the ledger on or off); one module-
+                # attribute load + None check per trace when health is off
+                hl = _dh.get_active()
+                if hl is not None:
+                    hl.note_trace(self.label, i, "step", _health_sig(batch),
+                                  capacity=jax.tree.leaves(batch)[0].shape[0]
+                                  if jax.tree.leaves(batch) else None)
                 states = list(states)
                 for j in range(i, len(self.ops)):
                     states[j], batch = self.ops[j].apply(states[j], batch)
@@ -182,6 +212,17 @@ class CompiledChain:
         key = ("scan", i)
         if key not in self._steps:
             def scan_step(states, stacked):
+                # compile-ledger hook — trace-time only, in the OUTER fn
+                # (lax.scan may trace `body` more than once; that is one
+                # executable, so it must count as one compile)
+                hl = _dh.get_active()
+                if hl is not None:
+                    leaves = jax.tree.leaves(stacked)
+                    hl.note_trace(
+                        self.label, i, "scan", _health_sig(stacked),
+                        capacity=leaves[0].shape[1] if leaves else None,
+                        k=leaves[0].shape[0] if leaves else None)
+
                 def body(carry, batch):
                     carry = list(carry)
                     for j in range(i, len(self.ops)):
@@ -202,7 +243,90 @@ class CompiledChain:
         if self.device is not None:
             b = jax.device_put(b, self.device)
         stacked = stack_batches([b] * int(k))
+        hl, t0c = self._health_begin("warm_scan")
         self._scan_fn(0)(tuple(self.states), stacked)
+        self._health_end(hl, t0c, 0, "scan", stacked)
+
+    # -- runtime-health ledger (MonitoringConfig.health) --------------------
+
+    def _health_begin(self, cause: str):
+        """(ledger, t0) when the health ledger is active: arm the cause and
+        the trace-count mark so :meth:`_health_end` can journal any compile
+        this invocation triggers with its measured duration.  (None, 0.0)
+        when health is off — the only off-path cost is this None check."""
+        hl = _dh.get_active()
+        if hl is None:
+            return None, 0.0
+        hl.set_cause(cause)
+        return hl, time.perf_counter()
+
+    def _health_end(self, hl, t0c: float, from_op: int, kind: str,
+                    example) -> None:
+        """Commit any trace notes the invocation parked: duration = the
+        whole first call (trace + XLA compile + first execution — the
+        honest number a user waits for), cost = AOT cost/memory analysis of
+        the just-compiled program (suppressed re-lowering, so it cannot
+        count as another compile)."""
+        if hl is None:
+            return
+        pending = hl.take_pending()
+        if not pending:
+            return
+        cost = self._health_cost(hl, from_op, kind, example)
+        hl.commit_pending(time.perf_counter() - t0c, cost,
+                          op=self.ops[from_op].getName() if self.ops else "",
+                          notes=pending)
+
+    def _health_cost(self, hl, from_op: int, kind: str, example) -> dict:
+        """AOT cost-analysis flops/bytes + executable memory footprint of
+        the program just compiled for (from_op, kind, example's shapes).
+        One extra lowering on the health path only (``hl.cost_analysis``
+        gates it); every failure degrades to an empty dict — the compile
+        event then simply carries no cost columns."""
+        if not hl.cost_analysis:
+            return {}
+        hl._suppress(True)
+        try:
+            fn = self._steps[from_op if kind == "step" else ("scan", from_op)]
+            compiled = fn.lower(tuple(self.states), example).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            out = {}
+            if ca.get("flops") is not None:
+                out["flops"] = int(ca["flops"])
+            if ca.get("bytes accessed") is not None:
+                out["bytes_accessed"] = int(ca["bytes accessed"])
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                out["argument_bytes"] = int(ma.argument_size_in_bytes)
+                out["output_bytes"] = int(ma.output_size_in_bytes)
+                out["temp_bytes"] = int(ma.temp_size_in_bytes)
+                out["code_bytes"] = int(ma.generated_code_size_in_bytes)
+            return out
+        except Exception:   # noqa: BLE001 — cost columns are best-effort,
+            return {}       # backend-dependent telemetry; the compile event
+            #                 itself (cause/key/duration) always lands
+        finally:
+            hl._suppress(False)
+
+    def state_footprints(self) -> dict:
+        """Per-operator state-pytree footprint in bytes, from static
+        shape/dtype metadata (the specs bound at construction — no device
+        access, no sync).  THE memory-ledger row tiered state (ROADMAP 3)
+        sizes its promotion/eviction against."""
+        out: dict = {}
+        for op, st in zip(self.ops, self.states):
+            n = 0
+            for leaf in jax.tree.leaves(st):
+                size = 1
+                for d in getattr(leaf, "shape", ()):
+                    size *= d
+                n += size * jax.numpy.dtype(
+                    getattr(leaf, "dtype", "float32")).itemsize
+            name = op.getName()
+            out[name] = out.get(name, 0) + n
+        return out
 
     def push_many(self, batches: Sequence[Batch],
                   from_op: int = 0) -> List[Batch]:
@@ -227,12 +351,26 @@ class CompiledChain:
         sampled = ((c % self.SERVICE_SAMPLE_EVERY) == 0
                    or (1 < c < self.SERVICE_SAMPLE_EVERY
                        and (c & (c - 1)) == 0))
+        hl, t0c = self._health_begin("push_many")
         t0 = time.perf_counter() if sampled else 0.0
         states, outs_stacked = self._scan_fn(from_op)(tuple(self.states),
                                                       stacked)
         if sampled:
+            # device-time attribution (health): the dispatch call above
+            # already returned asynchronously, so the split between "host
+            # dispatch" and "device completion" is one extra perf_counter
+            # on a path that pays a block_until_ready anyway
+            t_disp = time.perf_counter()
             jax.block_until_ready(outs_stacked)
-            service_s = time.perf_counter() - t0
+            t_done = time.perf_counter()
+            service_s = t_done - t0
+            # never attribute a launch that COMPILED (pending trace notes):
+            # its "dispatch" span is trace+XLA time, and the sums never
+            # decay — one such sample would mis-flag the stage forever
+            if (hl is not None and not hl.has_pending()
+                    and hl.service_sample()):
+                hl.note_service(self.label, dispatch_s=t_disp - t0,
+                                device_s=t_done - t_disp)
             if _journal.get_active() is not None:
                 _journal.record(
                     "dispatch_fused",
@@ -241,6 +379,10 @@ class CompiledChain:
                     service_s=round(service_s, 6))
         else:
             service_s = None
+        if hl is not None:
+            # after the timed window, so the cost-analysis lowering of a
+            # compile event can never inflate the service sample
+            self._health_end(hl, t0c, from_op, "scan", stacked)
         self.states = list(states)
         if sampled:
             # the fused launch is already synced: fold the event-time drop
@@ -287,11 +429,25 @@ class CompiledChain:
         sampled = ((c % self.SERVICE_SAMPLE_EVERY) == 0
                    or (1 < c < self.SERVICE_SAMPLE_EVERY
                        and (c & (c - 1)) == 0))
+        hl, t0c = self._health_begin("push")
         t0 = time.perf_counter() if sampled else 0.0
         states, out = self._step_fn(from_op)(tuple(self.states), batch)
         if sampled:
+            # device-time attribution (health): dispatch returned async, so
+            # t_disp - t0 is host-dispatch overhead and t_done - t_disp the
+            # device completion wait — riding the block_until_ready this
+            # sampled push already pays
+            t_disp = time.perf_counter()
             jax.block_until_ready(out)
-            service_s = time.perf_counter() - t0
+            t_done = time.perf_counter()
+            service_s = t_done - t0
+            # never attribute a launch that COMPILED (pending trace notes):
+            # its "dispatch" span is trace+XLA time, and the sums never
+            # decay — one such sample would mis-flag the stage forever
+            if (hl is not None and not hl.has_pending()
+                    and hl.service_sample()):
+                hl.note_service(self.label, dispatch_s=t_disp - t0,
+                                device_s=t_done - t_disp)
             # sampled compiled-program launch -> the event journal (no-op —
             # one None check — unless monitoring activated a journal)
             if _journal.get_active() is not None:
@@ -301,6 +457,10 @@ class CompiledChain:
                     service_s=round(service_s, 6))
         else:
             service_s = None
+        if hl is not None:
+            # after the timed window, so the cost-analysis lowering of a
+            # compile event can never inflate the service sample
+            self._health_end(hl, t0c, from_op, "step", batch)
         self.states = list(states)
         if sampled:
             # the sampled push already paid the block_until_ready: fold the
@@ -493,8 +653,9 @@ class Pipeline:
                 # that cannot execute
                 warm_caps = ({tuner.capacity, base} if tuner.converged
                              else self._ladder)
-                for c in sorted(warm_caps):
-                    self.chain.warm(c)
+                with _dh.cause("autotune_prewarm"):
+                    for c in sorted(warm_caps):
+                        self.chain.warm(c)
         admission = admission_from_config(cfg, base, driver="pipeline")
         return tuner, rebatcher, admission
 
@@ -538,10 +699,12 @@ class Pipeline:
             if dcfg.prewarm:
                 warm_ks = ({ktuner.capacity, 1} if ktuner.converged
                            else ladder)
-                for kr in sorted(warm_ks):
-                    self.chain.warm_scan(kr, base)
+                with _dh.cause("autotune_prewarm"):
+                    for kr in sorted(warm_ks):
+                        self.chain.warm_scan(kr, base)
         elif dcfg.prewarm and dcfg.k > 1:
-            self.chain.warm_scan(dcfg.k, base)
+            with _dh.cause("autotune_prewarm"):
+                self.chain.warm_scan(dcfg.k, base)
         return acc, ktuner
 
     def run(self):

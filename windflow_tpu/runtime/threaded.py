@@ -111,6 +111,10 @@ class ThreadedPipeline:
         for seg in segments:
             chain = CompiledChain(list(seg), spec, batch_capacity=cap,
                                   event_time=et)
+            # health-ledger stage label (compile journal + device-time
+            # attribution): the same per-segment name the flight recorder
+            # and ring edges use, so dispatch-bound rows line up with traces
+            chain.label = f"seg{len(self.chains)}"
             spec = chain.out_spec
             for op in chain.ops:
                 cap = op.out_capacity(cap)
