@@ -142,8 +142,10 @@ def nexmark_rows(rounds):
         parsed = d.get("parsed")
         nx = (parsed or {}).get("nexmark")
         et = (parsed or {}).get("nexmark_event_time")
+        tr = (parsed or {}).get("nexmark_tiered")
         row = {"round": n, "tps": nx if isinstance(nx, dict) else None,
                "event_time": et if isinstance(et, dict) else None,
+               "tiered": tr if isinstance(tr, dict) else None,
                "status": "ok" if isinstance(nx, dict) else
                ("FAILED" if parsed is None or d.get("rc") not in (0, None)
                 else "—")}
@@ -215,6 +217,27 @@ def render_nexmark(queries, rows) -> list:
                       if r["event_time"].get(q) is not None else "—")
                      for q in queries]
             lines.append(f"| r{r['round']:02d} | " + " | ".join(cells) + " |")
+    if any(r["tiered"] for r in rows):
+        # tiered-state spill rate of the 100x-keys acceptance row
+        # (`parsed.nexmark_tiered`): the HBM->host movement per step, the
+        # zero-overflow-drop claim, and the bounded p99 — all host+CPU
+        # measurable, so this trend moves even in tunnel-down rounds
+        lines += ["", "### tiered state — 100x-keys join "
+                      "(`parsed.nexmark_tiered`)", ""]
+        lines.append("| round | keys | hot | spills/step | readmits/step "
+                     "| overflow drops | p99 ms/step |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for r in rows:
+            t = r["tiered"]
+            if not t:
+                continue
+            lines.append(
+                f"| r{r['round']:02d} | {_fmt(t.get('keys'))} | "
+                f"{_fmt(t.get('hot_capacity'))} | "
+                f"{_fmt(t.get('spills_per_step'))} | "
+                f"{_fmt(t.get('readmits_per_step'))} | "
+                f"{_fmt(t.get('overflow_drops'))} | "
+                f"{_fmt(t.get('p99_step_ms'))} |")
     return lines
 
 

@@ -122,6 +122,31 @@ def memory_report(snap, series):
         lines.append("  per-operator state footprints:")
         for name, n in sorted(sb.items(), key=lambda kv: -kv[1]):
             lines.append(f"    {name:<28} {_fmt_bytes(n)}")
+    # tiered-state cross-reference: when headroom is at risk, name WHICH
+    # table to shrink — per-operator hot occupancy beside its HBM footprint
+    # (a hot table far below 100% is reclaimable headroom; one pegged at
+    # 100% with spill movement is already doing its job)
+    tiers = [(row.get("name", "?"), row["event_time"]["tier"])
+             for row in snap.get("operators", [])
+             if isinstance((row.get("event_time") or {}).get("tier"), dict)]
+    if tiers and (risky or sb):
+        lines.append("  tiered tables (hot occupancy vs footprint — the "
+                     "HEADROOM-RISK shrink candidates):")
+        for name, t in sorted(
+                tiers, key=lambda kv: -(sb.get(kv[0], 0) or 0)):
+            bits = []
+            if t.get("hot_used") is not None:
+                bits.append(f"hot={t.get('hot_used')}/{t.get('hot_slots')}"
+                            + (f" ({t['hot_pct']}%)"
+                               if t.get("hot_pct") is not None else ""))
+            if t.get("cold_keys") is not None:
+                bits.append(f"cold={t['cold_keys']} keys")
+            for k in ("state_spills", "state_readmits"):
+                if t.get(k):
+                    bits.append(f"{k.split('_')[1]}={t[k]}")
+            if name in sb:
+                bits.append(f"hbm={_fmt_bytes(sb[name])}")
+            lines.append(f"    {name:<28} " + "  ".join(bits))
     exes = sec.get("executables") or {}
     if exes:
         lines.append("  executable footprints (cache key: arg/out/temp/"

@@ -74,7 +74,8 @@ def _load_device_health():
 # ------------------------------------------------------------ report pieces
 
 #: occupancy-style percentages above this flag OVERFLOW-RISK in the
-#: pressure report (state tables drop, not grow, when full)
+#: pressure report (state tables drop, not grow, when full); the default
+#: for --risk-threshold
 RISK_PCT = 80.0
 
 
@@ -127,7 +128,18 @@ _PRESSURE_KEYS = (
 )
 
 
-def pressure_trends(snap, series):
+#: tier-section keys trended by the tier report: device-side occupancy +
+#: cold size (gauges) and the movement counters
+_TIER_GAUGES = (("hot_pct", "hot%"), ("hot_used", "hot-used"),
+                ("outbox_depth", "outbox"), ("cold_keys", "cold-keys"),
+                ("cold_rows", "cold-rows"),
+                ("l_cold_rows", "l-cold"), ("r_cold_rows", "r-cold"))
+_TIER_COUNTERS = (("state_spills", "spills"),
+                  ("state_readmits", "readmits"),
+                  ("state_compactions", "compactions"))
+
+
+def pressure_trends(snap, series, risk_pct=RISK_PCT):
     lines = ["== state-pressure trends =="]
     hist = {}                       # (op, key) -> [values over time]
     for s in series or [snap]:
@@ -144,10 +156,10 @@ def pressure_trends(snap, series):
                 continue
             vals = hist.get((name, key), [sec[key]])
             flag = ""
-            if key.endswith("pct") and max(vals) >= RISK_PCT:
+            if key.endswith("pct") and max(vals) >= risk_pct:
                 flag = "  [OVERFLOW-RISK]"
             if (key == "pending_depth" and sec.get("pending_capacity")
-                    and max(vals) >= RISK_PCT / 100.0
+                    and max(vals) >= risk_pct / 100.0
                     * sec["pending_capacity"]):
                 flag = "  [OVERFLOW-RISK]"
             lines.append(f"  {name:<28} {label:<14} "
@@ -159,6 +171,47 @@ def pressure_trends(snap, series):
             lines.append(f"  {name:<28} drops          "
                          + "  ".join(f"{k}={v}" for k, v in
                                      sorted(drops.items())))
+    return lines
+
+
+def tier_report(snap, series, risk_pct=RISK_PCT):
+    """Tiered-state sections: per-operator hot/cold occupancy and the
+    spill/readmit/compaction movement over the run (the ``tier`` sub-dict
+    the tiered operators put in their event_time snapshot rows)."""
+    lines = ["== tiered state (hot/cold) =="]
+    rows = [(name, sec["tier"]) for name, sec in _et_rows(snap)
+            if isinstance(sec.get("tier"), dict)]
+    if not rows:
+        lines.append("  (no tiered operators — enable with tiered= / "
+                     "WF_STATE_TIERED=1)")
+        return lines
+    hist = {}
+    for s in series or [snap]:
+        for name, sec in _et_rows(s):
+            t = sec.get("tier")
+            if not isinstance(t, dict):
+                continue
+            for key, _label in _TIER_GAUGES + _TIER_COUNTERS:
+                if key in t:
+                    hist.setdefault((name, key), []).append(t[key])
+    for name, t in rows:
+        for key, label in _TIER_GAUGES:
+            if key not in t:
+                continue
+            vals = hist.get((name, key), [t[key]])
+            flag = ("  [OVERFLOW-RISK]"
+                    if key == "hot_pct" and max(vals) >= risk_pct else "")
+            lines.append(f"  {name:<28} {label:<14} "
+                         f"first={vals[0]} last={vals[-1]} "
+                         f"max={max(vals)}{flag}")
+        moves = []
+        for key, label in _TIER_COUNTERS:
+            if key in t:
+                vals = hist.get((name, key), [t[key]])
+                moves.append(f"{label}={vals[-1]} (+{vals[-1] - vals[0]} "
+                             f"over run)")
+        if moves:
+            lines.append(f"  {name:<28} movement       " + "  ".join(moves))
     return lines
 
 
@@ -216,8 +269,12 @@ def main(argv=None) -> int:
     ap.add_argument("--q", type=float, default=0.99,
                     help="lateness quantile recommend_delay must cover "
                          "(default 0.99; 1.0 = every recorded straggler)")
+    ap.add_argument("--risk-threshold", type=float, default=RISK_PCT,
+                    metavar="PCT",
+                    help=f"occupancy percentage flagged [OVERFLOW-RISK] in "
+                         f"the pressure/tier reports (default {RISK_PCT})")
     ap.add_argument("--report", choices=("all", "watermarks", "pressure",
-                                         "lateness"), default="all",
+                                         "tier", "lateness"), default="all",
                     help="which section(s) to render (default all)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output: the latest snapshot's "
@@ -228,6 +285,10 @@ def main(argv=None) -> int:
     if not (0.0 < args.q <= 1.0):
         print(f"wf_state: --q must be in (0, 1], got {args.q}",
               file=sys.stderr)
+        return 2
+    if not (0.0 < args.risk_threshold <= 100.0):
+        print(f"wf_state: --risk-threshold must be in (0, 100], got "
+              f"{args.risk_threshold}", file=sys.stderr)
         return 2
     try:
         et = _load_event_time()
@@ -264,6 +325,9 @@ def main(argv=None) -> int:
                "event_time": snap.get("event_time") or {},
                "operators": {name: sec for name, sec in _et_rows(snap)},
                "recommendations": lat_data,
+               "risk_threshold": args.risk_threshold,
+               "tier": {name: sec["tier"] for name, sec in _et_rows(snap)
+                        if isinstance(sec.get("tier"), dict)},
                "snapshots": len(series)}
         if snap.get("hosts"):
             out["hosts"] = snap["hosts"]
@@ -274,7 +338,9 @@ def main(argv=None) -> int:
     if args.report in ("all", "watermarks"):
         blocks.append(watermark_map(snap))
     if args.report in ("all", "pressure"):
-        blocks.append(pressure_trends(snap, series))
+        blocks.append(pressure_trends(snap, series, args.risk_threshold))
+    if args.report in ("all", "tier"):
+        blocks.append(tier_report(snap, series, args.risk_threshold))
     if args.report in ("all", "lateness"):
         blocks.append(lat_lines)
     head = (f"wf_state: merged {snap.get('merged_from')} host(s): "

@@ -106,6 +106,10 @@ class LintConfig:
         os.path.join("windflow_tpu", "runtime", "supervisor.py"),
         os.path.join("windflow_tpu", "runtime", "checkpoint.py"),
         os.path.join("windflow_tpu", "control", "admission.py"),
+        # tiered keyed state: tier assignments and host-store content must
+        # replay exactly (the spill/readmit protocol is position-driven)
+        os.path.join("windflow_tpu", "state", "tiered.py"),
+        os.path.join("windflow_tpu", "state", "host_store.py"),
     )
     #: the central name registries (parsed with ast, never imported)
     names_file: str = os.path.join("windflow_tpu", "observability", "names.py")

@@ -52,7 +52,12 @@ PROXY_FACTOR = 3.0
 #: compile stays test-budget friendly, pinned in the baseline for honesty
 WORKLOAD_CAPACITY = {"ysb": 2048, "mp_matrix": 1024,
                      "nexmark_join": 512, "nexmark_session": 512,
-                     "nexmark_topn": 512}
+                     "nexmark_topn": 512,
+                     # the tiered-state miss->readmit->reprobe round: the
+                     # Nexmark join chain with tiered= on (resolve + probe
+                     # fallback + eviction compiled into the step; the
+                     # io_callback lowers to a host custom-call)
+                     "tiered_probe_miss": 512}
 
 #: scan-dispatch workloads: (base workload, K) — the K-fused
 #: ``CompiledChain._scan_fn`` program AOT-lowered and pinned beside the
@@ -134,12 +139,34 @@ def _build_nexmark_topn():
     return _build_nexmark("q6_topn", WORKLOAD_CAPACITY["nexmark_topn"])
 
 
+def _build_tiered_probe_miss():
+    """The q3 join chain with tiered state ON (``windflow_tpu/state``):
+    the pin covers the in-graph tier machinery — miss-resolution probes
+    (hot + outbox), the deterministic fresh-slot re-admission, the probe
+    fallback chain, and the pressure-eviction pack — compiled into the
+    SAME step as the join. Hot capacity clears the admission reserve
+    (WF114's sizing rule) at a 100x key space, so the compiled shape is
+    the acceptance workload's."""
+    from ..nexmark import make_query
+    from ..runtime.pipeline import CompiledChain
+    from ..benchmarks import device_cursor_step
+    cap = WORKLOAD_CAPACITY["tiered_probe_miss"]
+    src, ops = make_query("q3_enrich_join", 16 * cap,
+                          n_auctions=100 * 16, num_slots=2048,
+                          tiered=dict())
+    chain = CompiledChain(ops, src.payload_spec(), batch_capacity=cap,
+                          event_time=False)
+    step = device_cursor_step(chain, src, cap)
+    return chain, step, cap
+
+
 WORKLOADS: Dict[str, Callable] = {
     "ysb": _build_ysb,
     "mp_matrix": _build_mp_matrix,
     "nexmark_join": _build_nexmark_join,
     "nexmark_session": _build_nexmark_session,
     "nexmark_topn": _build_nexmark_topn,
+    "tiered_probe_miss": _build_tiered_probe_miss,
 }
 
 
@@ -333,6 +360,23 @@ def proxy_microbench(reps: int = 3) -> Dict[str, dict]:
         return st, vals["v"], hit
     f = jax.jit(join_step)
     out["join"] = {"elems": CJ, "seconds": _bench_one(f, jt, reps=reps)}
+
+    # spill: the tiered-state eviction/pack path (ops/lookup.py
+    # join_table_tier_evict: the deterministic coldness sort + outbox pack
+    # + slot clear) over a fully-loaded hot table — the device-side cost of
+    # moving one batch's worth of cold keys toward the host tier
+    from ..ops.lookup import (JOIN_KEY_SENTINEL, join_table_init,
+                              join_table_tier_evict, join_table_tier_init)
+    KT2, ST2 = 2048, 1024
+    vspec = {"v": jnp.zeros((), jnp.int32)}
+    ts0 = join_table_init(KT2, 8, vspec)
+    ts0 = join_table_tier_init(ts0, ST2, vspec)
+    ts0["key"] = jnp.asarray(rng.permutation(1 << 20)[:KT2].astype(np.int32))
+    ts0["used"] = jnp.ones((KT2,), jnp.bool_)
+    ts0["lap"] = jnp.asarray(rng.integers(0, 1 << 16, KT2).astype(np.int32))
+    ts0["tick"] = jnp.asarray(1 << 16, jnp.int32)
+    f = jax.jit(lambda s: join_table_tier_evict(s, KT2 // 2))
+    out["spill"] = {"elems": KT2, "seconds": _bench_one(f, ts0, reps=reps)}
 
     # dispatch: K batches through ONE fused push_many scan launch (the
     # runtime/dispatch.py hot path) — time per tuple of the fused call, with

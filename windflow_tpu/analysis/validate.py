@@ -69,6 +69,18 @@ WF113  error     runtime-health config the run cannot honor: the
                  never activate — the run would silently produce no
                  health artifacts), or an illegal
                  ``WF_HEALTH_SAMPLE`` (non-integer / < 1)
+WF114  warn/err  tiered keyed state (``windflow_tpu/state``) combined
+                 with a configuration its determinism/sizing contract
+                 cannot honor: sequence-id tracing or wall-clock
+                 admission under supervision (error — the ordered
+                 re-admission callbacks must replay against an
+                 identical admitted stream, the WF105/WF108 mirror); a
+                 hot table that does not clear its per-batch admission
+                 reserve (error — the zero-overflow-drop guarantee is
+                 structurally broken); a miss-resolution width outside
+                 the probe kernel's blockable geometry (warning — the
+                 ``_pallas_block`` gate routes the fused probe to the
+                 XLA reference inside the call)
 WF110  warn/err  scan dispatch (K > 1) combined with a configuration
                  the fused launch cannot honor: an unresolvable
                  ``dispatch=``/``WF_DISPATCH`` (error);
@@ -541,6 +553,105 @@ def _check_dispatch(report, dispatch, stored_arg, cfg, trace, stored_trace,
                      "group) or lower k for this topology")
 
 
+def _check_tiered(report, ops, cfg, trace, stored_trace,
+                  supervised: bool, where_prefix: str) -> None:
+    """WF114: tiered keyed state (``windflow_tpu/state``) against
+    configurations its determinism/sizing contract cannot honor.
+
+    - **error** — tiered state under supervision with sequence-id tracing
+      or a wall-clock admission bucket (the WF105/WF108 mirror): the
+      ordered re-admission callbacks replay in stream order, but a shifted
+      shed pattern / fresh trace ids would desynchronize the replayed
+      miss sequence from the failed attempt's host-store mutations.
+    - **error** — a tiered table whose hot capacity does not clear its
+      per-batch admission reserve (batch keys + parked pending keys): the
+      zero-overflow-drop guarantee is structurally broken, every batch
+      thrashes the whole table through the spill path.
+    - **warning** — the miss-resolution probe width does not satisfy the
+      probe kernel's blockable-geometry constraint (``ops/lookup.py::
+      _pallas_block``): with ``WF_KERNEL_IMPL=pallas`` the fused probe
+      falls back to the XLA reference inside the call (correct, slower).
+    """
+    from ..ops.lookup import _pallas_block
+    tiered = [(i, op, op._tier_cfg) for i, op in enumerate(ops)
+              if getattr(op, "_tier_cfg", None) is not None]
+    if not tiered:
+        return
+    if supervised:
+        from ..observability.tracing import TraceConfig
+        try:
+            tcfg = TraceConfig.resolve(trace if trace is not None
+                                       else stored_trace)
+        except (ValueError, TypeError):
+            tcfg = None                # already diagnosed as WF108
+        if tcfg is not None and tcfg.ids != "position":
+            report.add(
+                "WF114", "error", f"{where_prefix}:tiered",
+                f"tiered state with trace ids={tcfg.ids!r} under "
+                f"supervision: the spill/readmit protocol replays the "
+                f"ordered host callbacks by stream position, but sequence "
+                f"ids are minted from a process counter — a replay after "
+                f"restore would walk a different id timeline than the "
+                f"host-store mutations it re-derives",
+                hint="use TraceConfig(ids='position') (the default), the "
+                     "same contract supervised tracing itself requires")
+        if (cfg is not None and cfg.admission
+                and cfg.refill_per_batch is None):
+            report.add(
+                "WF114", "error", f"{where_prefix}:tiered",
+                "tiered state with wall-clock admission (rate_tps) under "
+                "supervision: eviction/re-admission decisions are a pure "
+                "function of the admitted stream, and a wall-clock refill "
+                "timeline shifts on restore — replay would re-derive "
+                "DIFFERENT tier assignments than the failed attempt spilled",
+                hint="use ControlConfig(refill_per_batch=...) so the "
+                     "admitted stream — and every tier decision — is a "
+                     "pure function of position")
+    for i, op, tc in tiered:
+        where = f"{where_prefix}.ops[{i}]:{op.getName()}"
+        cap = getattr(op, "_cap_resolved", None) \
+            or getattr(op, "_cap", None) or getattr(op, "_pending", None)
+        pending = getattr(op, "_pending_resolved", None)
+        if cap is None:
+            continue                    # not geometry-bound yet
+        hot = int(tc.hot_capacity
+                  or getattr(op, "_slots", None)
+                  or getattr(op, "num_slots", 0) or 0)
+        reserve = int(cap) + int(pending or 0)
+        if hot and pending is not None and hot <= reserve:
+            report.add(
+                "WF114", "error", where,
+                f"tiered hot capacity {hot} <= per-batch admission reserve "
+                f"{reserve} (batch capacity {cap} + pending ring "
+                f"{pending}): the miss-resolution pass can need a fresh "
+                f"slot for every resolved key, so the zero-overflow-drop "
+                f"guarantee is structurally broken and every batch "
+                f"thrashes the whole table through the spill path",
+                hint="raise num_slots/TierConfig.hot_capacity above "
+                     "batch + pending (the resolve width), or shrink the "
+                     "batch")
+        elif hot and pending is None and hot <= int(cap):
+            report.add(
+                "WF114", "error", where,
+                f"tiered hot capacity {hot} <= batch capacity {cap}: one "
+                f"batch of distinct keys can oversubscribe the hot "
+                f"directory — those lanes drop (counted overflow_drops)",
+                hint="raise num_keys/TierConfig.hot_capacity above the "
+                     "batch capacity")
+        width = int(cap) + int(pending or 0)
+        if width and not _pallas_block(width):
+            report.add(
+                "WF114", "warning", where,
+                f"tiered miss-resolution width {width} (batch + pending) "
+                f"does not satisfy the probe kernel's blockable-geometry "
+                f"constraint (ops/lookup.py::_pallas_block): under "
+                f"WF_KERNEL_IMPL=pallas the fused probe falls back to the "
+                f"XLA reference inside the call — correct, but the Pallas "
+                f"win silently disappears",
+                hint="keep batch + pending a multiple of 128 (or of 8192 "
+                     "beyond 8192 lanes) so the Pallas envelope holds")
+
+
 def _feeding_sources(mp) -> list:
     """Every source transitively feeding a graph pipe (through merges and
     split parents) — the WF112 session/event-time check needs to know
@@ -699,6 +810,8 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _validate_chain_ops(report, p.chain.ops, in_spec, None, "pipeline",
                         sink=p.sink)
     _check_stream_ops(report, p.chain.ops, in_spec, "pipeline", [p.source])
+    _check_tiered(report, p.chain.ops, cfg, trace,
+                  getattr(p, "_trace_arg", None), supervised, "pipeline")
     _check_faults(report, faults, "supervised" if supervised else "pipeline")
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(p, "_trace_arg", None), supervised)
@@ -718,6 +831,8 @@ def _validate_supervised(report, sp, faults, control, trace=None,
                         sink=sp.sink)
     _check_stream_ops(report, sp.chain.ops, in_spec, "supervised",
                       [sp.source])
+    _check_tiered(report, sp.chain.ops, cfg, trace,
+                  getattr(sp, "_trace_arg", None), True, "supervised")
     _check_faults(report, faults if faults is not None
                   else getattr(sp, "_faults_arg", None), "supervised")
     _check_admission(report, cfg, True, "control.admission")
@@ -734,9 +849,19 @@ def _validate_threaded(report, tp, faults, control, supervised,
                         f"source:{tp.source.getName()}")
     if spec is None:
         return
+    wf114_sup_done = False
     for i, chain in enumerate(tp.chains):
         # capacity None: segment chains were geometry-bound at construction
         _check_stream_ops(report, chain.ops, spec, f"seg{i}", [tp.source])
+        # supervised-combination findings emit once, from the FIRST segment
+        # that actually has tiered ops (the graph-driver convention)
+        has_tiered = any(getattr(op, "_tier_cfg", None) is not None
+                         for op in chain.ops)
+        _check_tiered(report, chain.ops, cfg, trace,
+                      getattr(tp, "_trace_arg", None),
+                      supervised and has_tiered and not wf114_sup_done,
+                      f"seg{i}")
+        wf114_sup_done = wf114_sup_done or has_tiered
         spec, _cap = _flow_ops(report, chain.ops, spec, f"seg{i}", None)
         if spec is None:
             break
@@ -806,6 +931,7 @@ def _validate_graph(report, g, faults, control, supervised,
     pipes = g._all_pipes()
     pipe_idx = {id(p): i for i, p in enumerate(pipes)}
     out_specs, out_caps = {}, {}
+    wf114_sup_done = False
     for mp in g._topo_order():
         where = f"pipe[{pipe_idx[id(mp)]}]"
         if mp.source is not None:
@@ -830,6 +956,15 @@ def _validate_graph(report, g, faults, control, supervised,
                 continue               # upstream already diagnosed
         _check_stream_ops(report, mp.ops, in_spec, where,
                           _feeding_sources(mp))
+        # supervised-combination findings emit once (first tiered pipe);
+        # the per-op geometry findings emit per pipe
+        has_tiered = any(getattr(op, "_tier_cfg", None) is not None
+                         for op in mp.ops)
+        _check_tiered(report, mp.ops, cfg, trace,
+                      getattr(g, "_trace_arg", None),
+                      supervised and has_tiered and not wf114_sup_done,
+                      where)
+        wf114_sup_done = wf114_sup_done or has_tiered
         out, out_cap = _flow_ops(report, mp.ops, in_spec, where, in_cap)
         out_specs[id(mp)] = out
         if out_cap is not None:

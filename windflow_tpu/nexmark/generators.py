@@ -53,16 +53,20 @@ def make_bid_source(total: int, name: str = "nexmark_bids") -> DeviceSource:
                         ts_fn=lambda i: i // EVENTS_PER_TICK)
 
 
-def make_enrich_source(total: int,
-                       name: str = "nexmark_enrich") -> DeviceSource:
-    """Tagged stream for the stream-table join: events ``0..N_AUCTIONS-1``
+def make_enrich_source(total: int, name: str = "nexmark_enrich",
+                       n_auctions: int = N_AUCTIONS) -> DeviceSource:
+    """Tagged stream for the stream-table join: events ``0..n_auctions-1``
     are auction definitions (``side == 1``, ``category`` set), the rest are
     bids (``side == 0``). Definitions strictly precede every bid in event
     time, so probe results are invariant to batching (the as-of-watermark
-    read sees every definition)."""
+    read sees every definition). ``n_auctions`` scales the key space — the
+    tiered-state acceptance workload runs this source at 100x the default
+    cardinality with the hot table unchanged."""
+    n_auctions = int(n_auctions)
+
     def gen(i):
-        is_def = i < N_AUCTIONS
-        auction = jnp.where(is_def, i, bid_auction(i))
+        is_def = i < n_auctions
+        auction = jnp.where(is_def, i, (i * 2477) % n_auctions)
         return {"side": jnp.where(is_def, 1, 0).astype(jnp.int32),
                 "auction": _i32(auction),
                 "category": jnp.where(is_def, (i * 13) % N_CATEGORIES,
@@ -70,8 +74,8 @@ def make_enrich_source(total: int,
                 "price": jnp.where(is_def, 0,
                                    bid_price(i)).astype(jnp.int32)}
     return DeviceSource(gen, total=total, name=name,
-                        key_fn=lambda i: jnp.where(i < N_AUCTIONS, i,
-                                                   bid_auction(i)),
+                        key_fn=lambda i: jnp.where(i < n_auctions, i,
+                                                   (i * 2477) % n_auctions),
                         ts_fn=lambda i: i // EVENTS_PER_TICK)
 
 

@@ -61,21 +61,27 @@ def q2_selection(total: int):
     return src, ops
 
 
-def q3_enrich_join(total: int):
-    src = g.make_enrich_source(total)
+def q3_enrich_join(total: int, n_auctions: int = g.N_AUCTIONS,
+                   num_slots: int = None, tiered=None):
+    """``n_auctions`` scales the key space (the 100x tiered acceptance
+    workload); ``num_slots`` pins the HOT table size independently of the
+    key space; ``tiered=`` opts the JoinTable into the two-tier state
+    layer (``windflow_tpu/state``)."""
+    src = g.make_enrich_source(total, n_auctions=n_auctions)
     ops = [StreamTableJoin(
         lambda t: t.side == 1,                 # auction definitions build
         lambda t: t.auction,
         lambda t: {"category": t.category},    # the enrichment column
-        num_slots=g.N_AUCTIONS, name="nexmark_enrich_join")]
+        num_slots=int(num_slots if num_slots is not None else n_auctions),
+        tiered=tiered, name="nexmark_enrich_join")]
     return src, ops
 
 
-def q4_interval_join(total: int, max_matches: int = 8):
+def q4_interval_join(total: int, max_matches: int = 8, tiered=None):
     src = g.make_open_bid_source(total)
     ops = [IntervalJoin(
         lambda t: t.side == 1,                 # auction opens are the left
-        0, JOIN_WINDOW, max_matches=max_matches,
+        0, JOIN_WINDOW, max_matches=max_matches, tiered=tiered,
         emit=lambda l, r: {"auction": l.data["auction"],
                            "open_ts": l.ts, "bid_ts": r.ts,
                            "price": r.data["price"]},
@@ -83,29 +89,30 @@ def q4_interval_join(total: int, max_matches: int = 8):
     return src, ops
 
 
-def q5_session(total: int):
+def q5_session(total: int, tiered=None):
     src = g.make_bid_source(total)
     ops = [KeyBy(lambda t: t.bidder, g.N_BIDDERS, name="nexmark_by_bidder"),
            SessionWindow(lambda t: {"bids": jnp.ones((), jnp.int32),
                                     "spend": t.price},
                          WindowSpec.session(SESSION_GAP),
-                         num_keys=g.N_BIDDERS, name="nexmark_session")]
+                         num_keys=g.N_BIDDERS, tiered=tiered,
+                         name="nexmark_session")]
     return src, ops
 
 
-def q6_topn(total: int):
+def q6_topn(total: int, tiered=None):
     src = g.make_bid_source(total)
     ops = [TopN(lambda t: t.price, TOP_N, num_keys=g.N_AUCTIONS,
-                name="nexmark_topn")]
+                tiered=tiered, name="nexmark_topn")]
     return src, ops
 
 
-def q7_distinct(total: int):
+def q7_distinct(total: int, tiered=None):
     src = g.make_bid_source(total)
     ops = [Filter(lambda t: t.auction % SELECT_MOD == 0,
                   name="nexmark_select"),
            Distinct(lambda t: t.auction, num_slots=g.N_AUCTIONS,
-                    name="nexmark_distinct")]
+                    tiered=tiered, name="nexmark_distinct")]
     return src, ops
 
 

@@ -493,9 +493,29 @@ _ET_MAX_KEYS = ("occupancy_pct", "pending_depth", "l_fill_pct", "r_fill_pct",
                 "open_sessions", "oldest_open_age", "lag")
 _ET_MIN_KEYS = ("watermark_ts", "fire_frontier_ts")
 
+#: tiered-state sub-section ("tier" in the event-time rows): occupancy /
+#: size gauges take MAX (the fleet view shows the worst host), the
+#: spill/readmit/compaction movement counters SUM
+_TIER_MAX_KEYS = ("hot_pct", "hot_used", "hot_slots", "outbox_slots",
+                  "outbox_depth", "cold_keys", "cold_rows",
+                  "l_cold_rows", "r_cold_rows")
+
+
+def _merge_tier_section(dst: dict, src: dict) -> None:
+    for k, v in (src or {}).items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k in _TIER_MAX_KEYS:
+            dst[k] = max(dst.get(k, v), v)
+        else:                       # state_spills/readmits/compactions
+            dst[k] = dst.get(k, 0) + v
+
 
 def _merge_et_section(dst: dict, src: dict) -> None:
     for k, v in (src or {}).items():
+        if k == "tier" and isinstance(v, dict):
+            _merge_tier_section(dst.setdefault("tier", {}), v)
+            continue
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             continue
         if k in _ET_MAX_KEYS:

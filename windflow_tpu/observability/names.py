@@ -63,6 +63,11 @@ JOURNAL_EVENTS = (
     # "kernel_resolve" = a per-backend kernel registry resolution
     # (ops/registry.py) observed while the ledger was active
     "compile", "retrace_unexpected", "kernel_resolve",
+    # tiered keyed state (state/tiered.py TieredTable, maintain cadence):
+    # "spill" = outbox rows settled into the host store, "readmit" = cold
+    # rows handed back to the device tier on probe miss — both carry
+    # (table, n, total); emitted on the driver thread only
+    "spill", "readmit",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -133,11 +138,19 @@ STAGE_COUNTERS = (
     "overflow_drops",      # ops/lookup.py JoinTable: pending-ring/table drops
     "old_drops",           # session/win_seqffat OLD straggler drops (also in
     #                        tuples_dropped_old — here beside the other drops)
+    # tiered keyed state (state/ + the per-operator tier wiring): device
+    # rows spilled to the outbox, cold rows re-admitted on probe miss, and
+    # host-store rows retired by watermark compaction
+    "state_spills", "state_readmits", "state_compactions",
 )
 
 #: per-stage gauges (same surface, ``windflow_stage_<name>`` gauge form)
 STAGE_GAUGES = (
     "join_table_version",  # applied upsert count of the op's own JoinTable
+    # tiered keyed state: hot-table occupancy (slots in use) and cold-tier
+    # key count — the per-operator tier_occupancy pair wf_state.py trends
+    # and wf_health.py cross-references against the HBM headroom gauge
+    "tier_hot_used", "tier_cold_keys",
 )
 
 #: per-operator event-time gauges of the watermark propagation map
@@ -201,6 +214,10 @@ PERF_PROXY_FAMILIES = (
     # probe, ops/lookup.py join_table_*) — the probe kernels keep their
     # microbench or tests/test_perfgate.py fails coverage
     "join",
+    # "spill" times the tiered-state eviction/pack path (ops/lookup.py
+    # join_table_tier_evict: coldness sort + outbox pack + slot clear) —
+    # the device-side half of the HBM->host spill protocol
+    "spill",
 )
 
 #: Nexmark-style benchmark queries (``windflow_tpu/nexmark/queries.py``).

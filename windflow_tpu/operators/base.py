@@ -131,6 +131,14 @@ class Basic_Operator:
         default."""
         return {}
 
+    def tier_controllers(self) -> tuple:
+        """The operator's tiered-state controllers (``state/tiered.py``
+        ``TieredTable``, one per tiered table) — empty unless the operator
+        was built with ``tiered=`` on.  ``CompiledChain`` runs their
+        ``maintain`` after every push (the async spill settle point) and
+        snapshots/restores their host stores with the operator states."""
+        return ()
+
     # pythonic aliases
     name = property(getName)
     parallelism = property(getParallelism)

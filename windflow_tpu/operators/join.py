@@ -40,9 +40,11 @@ import jax.numpy as jnp
 from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
 from ..batch import Batch, CTRL_DTYPE, TupleRef, tuple_refs
 from ..observability import event_time as _et
-from ..ops.lookup import (JOIN_KEY_SENTINEL, join_table_init,
+from ..ops.lookup import (JOIN_KEY_SENTINEL, count_drops, join_table_init,
                           join_table_probe, join_table_stats,
-                          join_table_upsert)
+                          join_table_tier_evict, join_table_tier_init,
+                          join_table_tier_resolve, join_table_tier_stats,
+                          join_table_tier_touch, join_table_upsert)
 from .base import Basic_Operator
 
 _IMIN = -(1 << 31)
@@ -51,6 +53,22 @@ _IMIN = -(1 << 31)
 def _ref_spec(payload_spec):
     s = jax.ShapeDtypeStruct((), CTRL_DTYPE)
     return TupleRef(key=s, id=s, ts=s, data=payload_spec)
+
+
+def _tier_counters(state, tier) -> dict:
+    """Per-stage tier counters/gauges of one tiered keyed table (names
+    registered in ``observability/names.py`` — the count_drops discipline):
+    device movement counters + hot/cold occupancy."""
+    import numpy as np
+    used_key = "used" if "used" in state else "hused"
+    return {
+        "state_spills": int(np.asarray(state["spills"])),
+        "state_readmits": int(np.asarray(state["readmits"])),
+        "state_compactions":
+            tier.controller.counters()["state_compactions"],
+        "tier_hot_used": int(np.asarray(state[used_key]).sum()),
+        "tier_cold_keys": tier.store.key_count(),
+    }
 
 
 def _default_pair_emit(l: TupleRef, r: TupleRef):
@@ -86,6 +104,7 @@ class StreamTableJoin(Basic_Operator):
                  *, num_slots: int = DEFAULT_MAX_KEYS,
                  pending: Optional[int] = None, delay: int = 0,
                  emit: Optional[Callable] = None, emit_misses: bool = False,
+                 tiered=None,
                  name: str = "stream_table_join", parallelism: int = 1):
         super().__init__(name, parallelism)
         if delay < 0:
@@ -100,8 +119,15 @@ class StreamTableJoin(Basic_Operator):
         self.emit = emit
         self._pending_resolved = pending
         self._version_synced = 0
+        # tiered keyed state (ROADMAP 3): None consults WF_STATE_TIERED —
+        # off by default, OFF path byte-for-byte today's state/programs
+        from ..state import TierConfig
+        self._tier_cfg = TierConfig.resolve(tiered)
+        self._tier = None
+        self._cap_resolved = None
 
     def bind_geometry(self, batch_capacity: int) -> None:
+        self._cap_resolved = int(batch_capacity)
         if self.pending is None:
             # one batch of pure build tuples must always fit, with headroom
             # for upserts parked behind a nonzero delay
@@ -121,14 +147,31 @@ class StreamTableJoin(Basic_Operator):
 
     def init_state(self, payload_spec: Any):
         pending = self._pending_resolved or 2 * DEFAULT_MAX_KEYS
-        state = join_table_init(self.num_slots, pending,
-                                self._val_spec(payload_spec))
+        vspec = self._val_spec(payload_spec)
+        if self._tier_cfg is not None:
+            from ..state.tiered import JoinTableTier
+            hot = int(self._tier_cfg.hot_capacity or self.num_slots)
+            # per-batch admission bound: the resolve pass may readmit every
+            # distinct batch key plus every parked pending key, so the hot
+            # table keeps that many slots free (WF114 checks hot > reserve)
+            cap = self._cap_resolved or DEFAULT_MAX_KEYS
+            self._reserve = cap + pending
+            self._hot_target = max(1, hot - self._reserve)
+            outbox = int(self._tier_cfg.outbox or 4 * self._reserve)
+            state = join_table_init(hot, pending, vspec)
+            state = join_table_tier_init(state, outbox, vspec)
+            self._tier = JoinTableTier(self.name, vspec, self._tier_cfg)
+        else:
+            state = join_table_init(self.num_slots, pending, vspec)
         if self._event_time:
             # build-side lateness histogram (event-time observability only:
             # absent from the state pytree — and from the compiled program —
             # when the toggle is off)
             state["lat_hist"] = _et.lateness_init()
         return state
+
+    def tier_controllers(self):
+        return (self._tier.controller,) if self._tier is not None else ()
 
     def out_spec(self, payload_spec: Any) -> Any:
         vspec = self._val_spec(payload_spec)
@@ -140,16 +183,48 @@ class StreamTableJoin(Basic_Operator):
         probe_mask = batch.valid & ~build
         jkey = jax.vmap(self.key_fn)(refs).astype(jnp.int32)
         bval = jax.vmap(self.val_fn)(refs)
+        fb_vals = fb_ok = None
+        if self._tier is not None:
+            # miss -> readmit -> (re)probe, BEFORE the upsert: resolve every
+            # batch key AND every parked pending key (a parked upsert's key
+            # stays hot until it applies, so the LWW never-roll-back check
+            # always sees the applied version — placement-independent)
+            rkeys = jnp.concatenate([jkey, state["pkey"]])
+            rok = jnp.concatenate([batch.valid, state["pok"]])
+            state, fb_vals, fb_ok = join_table_tier_resolve(
+                state, rkeys, rok, self._tier.lookup_cb)
         # upsert BEFORE probe: a probe sees every build tuple up to and
-        # including its own batch (the as-of-watermark read point)
+        # including its own batch (the as-of-watermark read point); with
+        # tiering on, a saturated table diverts winning upserts to the
+        # spill outbox instead of dropping them
         state = join_table_upsert(state, jkey, bval, batch.ts, batch.id,
-                                  build, delay=self.delay)
+                                  build, delay=self.delay,
+                                  divert=self._tier is not None)
         if self._event_time:
             # observed build-side lateness vs the post-upsert watermark: one
             # masked reduction, results untouched (the hist is state-only)
             state = dict(state, lat_hist=_et.lateness_update(
                 state["lat_hist"], state["wm"], batch.ts, build))
         vals, hit = join_table_probe(state, jkey, probe_mask)
+        if self._tier is not None:
+            # saturation fallback chain: a probe lane that still misses the
+            # hot table reads (1) the newest outbox entry of its key —
+            # covering this batch's diverted upserts and unsettled spills —
+            # then (2) the resolve pass's host-store value; results never
+            # depend on tier placement
+            from ..ops.lookup import join_table_tier_fallback
+            C = batch.capacity
+            ob_vals, ob_hit = join_table_tier_fallback(
+                state, jkey, probe_mask & ~hit)
+            fb = fb_ok[:C] & probe_mask & ~hit & ~ob_hit
+            vals = jax.tree.map(
+                lambda v, o, f: jnp.where(
+                    ob_hit, o.astype(v.dtype),
+                    jnp.where(fb, f[:C].astype(v.dtype), v)),
+                vals, ob_vals, fb_vals)
+            hit = hit | ob_hit | fb
+            state = join_table_tier_touch(state, jkey, batch.valid)
+            state = join_table_tier_evict(state, self._hot_target)
         payload = jax.vmap(self._emit)(refs, vals)
         valid = probe_mask & (hit | self.emit_misses)
         return state, batch.replace(payload=payload, valid=valid)
@@ -163,9 +238,12 @@ class StreamTableJoin(Basic_Operator):
         if v != self._version_synced:
             self._version_synced = v
             _cstate.set_gauge("join_table_version", float(v))
-        self._publish_stage_counters({
+        counters = {
             "join_table_version": v,
-            "overflow_drops": int(np.asarray(state["dropped"]))})
+            "overflow_drops": int(np.asarray(state["dropped"]))}
+        if self._tier is not None:
+            counters.update(_tier_counters(state, self._tier))
+        self._publish_stage_counters(counters)
 
     def drop_counters(self, state: Any = None) -> dict:
         if state is None:
@@ -181,6 +259,9 @@ class StreamTableJoin(Basic_Operator):
             return None
         out = join_table_stats(state)
         out["delay"] = self.delay
+        if self._tier is not None:
+            out["tier"] = {**join_table_tier_stats(state),
+                           **self._tier.controller.stats()}
         counts = _et.read_hist(state.get("lat_hist"))
         if counts is not None:
             out["lateness"] = {"build": _et.summarize(counts)}
@@ -214,7 +295,7 @@ class IntervalJoin(Basic_Operator):
                  archive: Optional[int] = None, max_matches: int = 4,
                  delay: int = 0, emit: Optional[Callable] = None,
                  ts_l: Optional[Callable] = None,
-                 ts_r: Optional[Callable] = None,
+                 ts_r: Optional[Callable] = None, tiered=None,
                  name: str = "interval_join", parallelism: int = 1):
         super().__init__(name, parallelism)
         self.side_fn = side_fn
@@ -231,8 +312,16 @@ class IntervalJoin(Basic_Operator):
         if self.delay < 0:
             raise ValueError(f"{name}: delay (lateness) must be >= 0")
         self._archive_resolved = archive
+        # tiered archives: ring-overwritten LIVE rows (today's arch_drops)
+        # spill to per-side multimap host stores and come back as extra
+        # match candidates; the watermark frontier retires them
+        from ..state import TierConfig
+        self._tier_cfg = TierConfig.resolve(tiered)
+        self._tier_l = self._tier_r = None
+        self._cap_resolved = None
 
     def bind_geometry(self, batch_capacity: int) -> None:
+        self._cap_resolved = int(batch_capacity)
         a = self.archive if self.archive is not None \
             else 2 * int(batch_capacity)
         if a < batch_capacity:
@@ -268,12 +357,40 @@ class IntervalJoin(Basic_Operator):
                  "wm": jnp.asarray(_IMIN, jnp.int32),
                  "match_drops": jnp.asarray(0, jnp.int32),
                  "arch_drops": jnp.asarray(0, jnp.int32)}
+        if self._tier_cfg is not None:
+            from ..state.tiered import ArchiveTier
+            S = int(self._tier_cfg.outbox
+                    or 4 * (self._cap_resolved or DEFAULT_MAX_KEYS))
+            for p in ("l", "r"):
+                state[f"{p}okey"] = jnp.full((S,), JOIN_KEY_SENTINEL,
+                                             jnp.int32)
+                state[f"{p}ots"] = jnp.zeros((S,), jnp.int32)
+                state[f"{p}oid"] = jnp.zeros((S,), jnp.int32)
+                state[f"{p}opay"] = jax.tree.map(
+                    lambda s: jnp.zeros((S,) + tuple(s.shape), s.dtype),
+                    payload_spec)
+                state[f"{p}ocnt"] = jnp.asarray(0, jnp.int32)
+            state["spills"] = jnp.asarray(0, jnp.int32)
+            state["readmits"] = jnp.asarray(0, jnp.int32)
+            # per-side retention bounds — the SAME arithmetic the in-graph
+            # eviction applies to the rings (fired_hi_tb family)
+            self._tier_l = ArchiveTier(
+                self.name, payload_spec, self._tier_cfg, "l",
+                lambda wm: wm - self.delay - self.upper)
+            self._tier_r = ArchiveTier(
+                self.name, payload_spec, self._tier_cfg, "r",
+                lambda wm: wm - self.delay + self.lower)
         if self._event_time:
             # per-side observed-lateness histograms (event-time monitoring
             # only — absent otherwise, so the off program is unchanged)
             state["lat_l"] = _et.lateness_init()
             state["lat_r"] = _et.lateness_init()
         return state
+
+    def tier_controllers(self):
+        if self._tier_l is None:
+            return ()
+        return (self._tier_l.controller, self._tier_r.controller)
 
     def _event_ts(self, refs, is_l, batch):
         if self.ts_l is None and self.ts_r is None:
@@ -363,6 +480,101 @@ class IntervalJoin(Basic_Operator):
         }
         return out, (cur + csum[-1]) % A, overwrote
 
+    def _append_spill(self, state, p, side, cur, mask, key, ets, batch):
+        """Tiered ring-append: a LIVE row the ring is about to overwrite
+        (still inside its match window — today's arch_drop) is packed into
+        the side's spill outbox first; only outbox exhaustion still drops.
+        Returns (side, cur, dropped, spilled, outbox updates)."""
+        A = side["key"].shape[0]
+        csum = jnp.cumsum(mask.astype(jnp.int32))
+        pos = (cur + csum - 1) % A
+        idx = jnp.where(mask, pos, A)
+        ow = mask & jnp.take(side["ok"], pos)
+        S = state[f"{p}okey"].shape[0]
+        orank = jnp.cumsum(ow.astype(jnp.int32)) - 1
+        fits = ow & (state[f"{p}ocnt"] + orank < S)
+        opos = jnp.where(fits, state[f"{p}ocnt"] + orank, S)
+        upd = {
+            f"{p}okey": state[f"{p}okey"].at[opos].set(
+                jnp.take(side["key"], pos), mode="drop"),
+            f"{p}ots": state[f"{p}ots"].at[opos].set(
+                jnp.take(side["ts"], pos), mode="drop"),
+            f"{p}oid": state[f"{p}oid"].at[opos].set(
+                jnp.take(side["id"], pos), mode="drop"),
+            f"{p}opay": jax.tree.map(
+                lambda t, a: t.at[opos].set(jnp.take(a, pos, axis=0),
+                                            mode="drop"),
+                state[f"{p}opay"], side["pay"]),
+            f"{p}ocnt": state[f"{p}ocnt"]
+            + jnp.sum(fits.astype(jnp.int32)),
+        }
+        out = {
+            "key": side["key"].at[idx].set(key, mode="drop"),
+            "ts": side["ts"].at[idx].set(ets, mode="drop"),
+            "id": side["id"].at[idx].set(batch.id, mode="drop"),
+            "ok": side["ok"].at[idx].set(True, mode="drop"),
+            "pay": jax.tree.map(lambda t, v: t.at[idx].set(v, mode="drop"),
+                                side["pay"], batch.payload),
+        }
+        dropped = jnp.sum((ow & ~fits).astype(jnp.int32))
+        spilled = jnp.sum(fits.astype(jnp.int32))
+        return out, (cur + csum[-1]) % A, dropped, spilled, upd
+
+    def _cold_candidates(self, state, batch, lmask, rmask, horizon):
+        """Extra match candidates from the cold tiers: each side's spill
+        outbox (in state — unsettled spills stay probeable) + up to
+        ``readmit_rows`` host-store rows per probing lane (ONE ordered
+        ``io_callback`` per side), both masked by the same per-side
+        eviction frontier the rings apply. Returns (right extras for left
+        probes, left extras for right probes, rows fetched). NOTE: for the
+        interval join ``state_readmits`` counts cold rows SERVED as
+        candidates — the fetch is read-only (rows never change tiers), so
+        a persistent in-window cold row counts once per probing batch."""
+        from jax.experimental import io_callback
+        C = batch.capacity
+        M = int(self._tier_cfg.readmit_rows)
+        leaves = jax.tree.leaves(batch.payload)
+        treedef = jax.tree.structure(batch.payload)
+
+        def fetch(tier, want, frontier):
+            shapes = ([jax.ShapeDtypeStruct((C, M), jnp.bool_),
+                       jax.ShapeDtypeStruct((C, M), jnp.int32),
+                       jax.ShapeDtypeStruct((C, M), jnp.int32)]
+                      + [jax.ShapeDtypeStruct((C, M) + leaf.shape[1:],
+                                              leaf.dtype)
+                         for leaf in leaves])
+            res = io_callback(tier.fetch_cb, shapes, batch.key, want,
+                              ordered=True)
+            mask = res[0] & want[:, None] & (res[1] >= frontier)
+            # candidates are GLOBAL (every probe lane sees the whole
+            # axis): a row fetched by N lanes of the same key must appear
+            # once, not N times — dedup by tuple id (unique per row)
+            from ..ops.segment import segment_rank
+            ids_flat = res[2].reshape(-1)
+            uniq = mask.reshape(-1) & (segment_rank(
+                ids_flat, mask.reshape(-1)) == 0)
+            k2 = jnp.where(uniq, jnp.repeat(batch.key, M),
+                           JOIN_KEY_SENTINEL)
+            pay = jax.tree.unflatten(treedef, [
+                r.reshape((-1,) + r.shape[2:]) for r in res[3:]])
+            return (k2, res[1].reshape(-1), ids_flat, uniq, pay), \
+                jnp.sum(uniq.astype(jnp.int32))
+
+        def outbox(p, frontier):
+            S = state[f"{p}okey"].shape[0]
+            live = (jnp.arange(S, dtype=jnp.int32) < state[f"{p}ocnt"]) \
+                & (state[f"{p}ots"] >= frontier)
+            return (state[f"{p}okey"], state[f"{p}ots"], state[f"{p}oid"],
+                    live, state[f"{p}opay"])
+
+        r_front = horizon + self.lower
+        l_front = horizon - self.upper
+        r_fetch, n_r = fetch(self._tier_r, lmask, r_front)
+        l_fetch, n_l = fetch(self._tier_l, rmask, l_front)
+        r_extra = [outbox("r", r_front), r_fetch]
+        l_extra = [outbox("l", l_front), l_fetch]
+        return r_extra, l_extra, n_r + n_l
+
     def apply(self, state, batch: Batch):
         refs = tuple_refs(batch)
         is_l = jax.vmap(self.side_fn)(refs).astype(jnp.bool_)
@@ -381,14 +593,33 @@ class IntervalJoin(Basic_Operator):
         l["ok"] = l["ok"] & (l["ts"] >= horizon - self.upper)
         r["ok"] = r["ok"] & (r["ts"] >= horizon + self.lower)
         # left probes see archived rights PLUS the batch's own rights (an
-        # in-batch pair counts once, from the left side)
+        # in-batch pair counts once, from the left side); with tiering on,
+        # each side's spill outbox + host-store rows join the candidate set
+        # (appended AFTER archive + batch lanes, so candidate rank — and
+        # therefore the max_matches truncation order — is unchanged when
+        # the cold tiers are empty)
         cat = lambda a, b: jnp.concatenate([a, b], axis=0)
+        catn = lambda *xs: jnp.concatenate(xs, axis=0)
         r_cand = (cat(r["key"], jnp.where(rmask, batch.key,
                                           JOIN_KEY_SENTINEL)),
                   cat(r["ts"], ets), cat(r["id"], batch.id),
                   cat(r["ok"], rmask),
                   jax.tree.map(cat, r["pay"], batch.payload))
         l_cand = (l["key"], l["ts"], l["id"], l["ok"], l["pay"])
+        tier_upd = {}
+        if self._tier_l is not None:
+            r_extra, l_extra, n_fetched = self._cold_candidates(
+                state, batch, lmask, rmask, horizon)
+            def join_c(base, extras):
+                return (catn(base[0], *(e[0] for e in extras)),
+                        catn(base[1], *(e[1] for e in extras)),
+                        catn(base[2], *(e[2] for e in extras)),
+                        catn(base[3], *(e[3] for e in extras)),
+                        jax.tree.map(catn, base[4],
+                                     *(e[4] for e in extras)))
+            r_cand = join_c(r_cand, r_extra)
+            l_cand = join_c(l_cand, l_extra)
+            tier_upd["readmits"] = state["readmits"] + n_fetched
         lrows = self._rows(batch, lmask, ets, r_cand, swap=False)
         rrows = self._rows(batch, rmask, ets, l_cand, swap=True)
         valid = cat(lrows[0], rrows[0])
@@ -396,13 +627,26 @@ class IntervalJoin(Basic_Operator):
                     ts=cat(lrows[2], rrows[2]),
                     payload=jax.tree.map(cat, lrows[4], rrows[4]),
                     valid=valid)
-        l, lcur, odl = self._append(l, state["lcur"], lmask, batch.key, ets,
-                                    batch)
-        r, rcur, odr = self._append(r, state["rcur"], rmask, batch.key, ets,
-                                    batch)
-        new_state = {"l": l, "r": r, "lcur": lcur, "rcur": rcur, "wm": wm,
-                     "match_drops": state["match_drops"] + lrows[5] + rrows[5],
-                     "arch_drops": state["arch_drops"] + odl + odr}
+        if self._tier_l is not None:
+            l, lcur, odl, spl, upd_l = self._append_spill(
+                state, "l", l, state["lcur"], lmask, batch.key, ets, batch)
+            tier_upd.update(upd_l)
+            r, rcur, odr, spr, upd_r = self._append_spill(
+                state, "r", r, state["rcur"], rmask, batch.key, ets, batch)
+            tier_upd.update(upd_r)
+            tier_upd["spills"] = state["spills"] + spl + spr
+        else:
+            l, lcur, odl = self._append(l, state["lcur"], lmask, batch.key,
+                                        ets, batch)
+            r, rcur, odr = self._append(r, state["rcur"], rmask, batch.key,
+                                        ets, batch)
+        new_state = dict(
+            state, l=l, r=r, lcur=lcur, rcur=rcur, wm=wm,
+            match_drops=count_drops(state["match_drops"], "match_drops",
+                                    lrows[5] + rrows[5]),
+            arch_drops=count_drops(state["arch_drops"], "arch_drops",
+                                   odl + odr))
+        new_state.update(tier_upd)
         if self._event_time:
             # per-stream lateness vs the post-batch watermark: one masked
             # reduction per side, state-only (results untouched)
@@ -415,7 +659,19 @@ class IntervalJoin(Basic_Operator):
     def collect_stats(self, state: Any = None) -> None:
         if state is None:
             return
-        self._publish_stage_counters(self.drop_counters(state))
+        counters = dict(self.drop_counters(state))
+        if self._tier_l is not None:
+            import numpy as np
+            counters.update({
+                "state_spills": int(np.asarray(state["spills"])),
+                "state_readmits": int(np.asarray(state["readmits"])),
+                "state_compactions":
+                    self._tier_l.store.compacted_rows
+                    + self._tier_r.store.compacted_rows,
+                "tier_cold_keys": self._tier_l.store.key_count()
+                + self._tier_r.store.key_count(),
+            })
+        self._publish_stage_counters(counters)
 
     def drop_counters(self, state: Any = None) -> dict:
         if state is None:
@@ -450,6 +706,18 @@ class IntervalJoin(Basic_Operator):
             "match_drops": int(np.asarray(state["match_drops"])),
             "arch_drops": int(np.asarray(state["arch_drops"])),
         }
+        if self._tier_l is not None:
+            out["tier"] = {
+                "outbox_depth": int(np.asarray(state["locnt"]))
+                + int(np.asarray(state["rocnt"])),
+                "state_spills": int(np.asarray(state["spills"])),
+                "state_readmits": int(np.asarray(state["readmits"])),
+                "l_cold_rows": len(self._tier_l.store),
+                "r_cold_rows": len(self._tier_r.store),
+                **{k: self._tier_l.store.counters()[k]
+                   + self._tier_r.store.counters()[k]
+                   for k in ("state_compactions",)},
+            }
         lat = {}
         for stream, key in (("l", "lat_l"), ("r", "lat_r")):
             counts = _et.read_hist(state.get(key))

@@ -31,9 +31,13 @@ import jax.numpy as jnp
 from ..basic import routing_modes_t, DEFAULT_MAX_KEYS
 from ..batch import Batch, CTRL_DTYPE, TupleRef, tuple_refs
 from ..ops.bitonic import sort_network
-from ..ops.lookup import join_table_init, join_table_probe, join_table_upsert
+from ..ops.lookup import (count_drops, join_table_init, join_table_probe,
+                          join_table_tier_evict, join_table_tier_init,
+                          join_table_tier_resolve, join_table_tier_stats,
+                          join_table_tier_touch, join_table_upsert)
 from ..ops.segment import segment_rank
 from .base import Basic_Operator
+from .join import _tier_counters
 
 #: empty-slot score: sorts after every real candidate under the negated
 #: composite key (user scores must be > INT32_MIN + 1)
@@ -57,8 +61,8 @@ class TopN(Basic_Operator):
     routing = routing_modes_t.KEYBY
 
     def __init__(self, score_fn: Callable, n: int, *,
-                 num_keys: int = DEFAULT_MAX_KEYS, name: str = "topn",
-                 parallelism: int = 1):
+                 num_keys: int = DEFAULT_MAX_KEYS, tiered=None,
+                 name: str = "topn", parallelism: int = 1):
         super().__init__(name, parallelism)
         self.score_fn = score_fn
         self.n = int(n)
@@ -66,25 +70,60 @@ class TopN(Basic_Operator):
         if self.n < 1:
             raise ValueError(f"{name}: n must be >= 1")
         self._evict_synced = 0
+        # tiered keyed state: a key -> hot-slot directory in front of the
+        # direct-indexed [K, N] leaderboard; cold leaderboards spill to the
+        # host store and readmit on touch (state/tiered.py slot directory)
+        from ..state import TierConfig
+        self._tier_cfg = TierConfig.resolve(tiered)
+        self._tier = None
+        self._cap_resolved = None
+        self._slots = (int(self._tier_cfg.hot_capacity or num_keys)
+                       if self._tier_cfg is not None else self.num_keys)
+
+    def bind_geometry(self, batch_capacity: int) -> None:
+        self._cap_resolved = int(batch_capacity)
 
     def out_capacity(self, in_capacity: int) -> int:
-        return self.num_keys * self.n
+        return self._slots * self.n
 
     def out_spec(self, payload_spec: Any) -> Any:
         i = jax.ShapeDtypeStruct((), CTRL_DTYPE)
         return {"score": i, "rank": i}
 
     def init_state(self, payload_spec: Any):
-        K, N = self.num_keys, self.n
-        return {"score": jnp.full((K, N), TOPN_SENTINEL, jnp.int32),
-                "tid": jnp.zeros((K, N), jnp.int32),
-                "evict": jnp.asarray(0, jnp.int32),
-                "eos": jnp.asarray(0, jnp.int32)}
+        K, N = self._slots, self.n
+        state = {"score": jnp.full((K, N), TOPN_SENTINEL, jnp.int32),
+                 "tid": jnp.zeros((K, N), jnp.int32),
+                 "evict": jnp.asarray(0, jnp.int32),
+                 "eos": jnp.asarray(0, jnp.int32)}
+        if self._tier_cfg is not None:
+            from ..state.tiered import SlotTableTier, slot_directory_init
+            cap = self._cap_resolved or DEFAULT_MAX_KEYS
+            self._hot_target = max(1, K - min(cap, K - 1))
+            outbox = int(self._tier_cfg.outbox or 4 * cap)
+            state.update(slot_directory_init(K, outbox, {
+                "oscore": lambda s: jnp.full((s, N), TOPN_SENTINEL,
+                                             jnp.int32),
+                "otid": lambda s: jnp.zeros((s, N), jnp.int32)}))
+            state["ovf"] = jnp.asarray(0, jnp.int32)
+            self._tier = SlotTableTier(
+                self.name,
+                {"score": (jnp.int32, (N,)), "tid": (jnp.int32, (N,))},
+                self._tier_cfg, count_key="ocnt",
+                col_keys=["okey", "otick", "oscore", "otid"],
+                state_to_store=lambda n, host: (
+                    host["okey"], host["otick"],
+                    {"score": host["oscore"], "tid": host["otid"]}),
+                wm_key=None)
+        return state
+
+    def tier_controllers(self):
+        return (self._tier.controller,) if self._tier is not None else ()
 
     def _merge(self, state, keymat, scores, ids):
         """Merge [K, C] candidates into the [K, N] leaderboard via one
         vmapped bitonic sort network over the padded composite key."""
-        K, N = self.num_keys, self.n
+        K, N = self._slots, self.n
         cscore = jnp.where(keymat, scores[None, :], TOPN_SENTINEL)
         cid = jnp.where(keymat, ids[None, :], 0)
         alls = jnp.concatenate([state["score"], cscore], axis=1)
@@ -101,7 +140,65 @@ class TopN(Basic_Operator):
         return -neg[:, :N], sid[:, :N]
 
     def apply(self, state, batch: Batch):
-        K, N = self.num_keys, self.n
+        if self._tier is None:
+            return self._apply_core(state, batch)
+        from ..state.tiered import slot_directory_evict, \
+            slot_directory_resolve
+        K, N = self._slots, self.n
+        state, slot, live = slot_directory_resolve(
+            state, batch.key, batch.valid, self._tier.lookup_cb,
+            self._host_shapes(), self._admit_write)
+        # lanes whose key could not get a hot slot (directory saturated):
+        # counted overflow, like an untiered table beyond num_keys
+        state = dict(state, ovf=count_drops(
+            state["ovf"], "overflow_drops",
+            jnp.sum((batch.valid & ~live).astype(jnp.int32))))
+        b2 = batch.replace(key=jnp.where(live, slot, 0), valid=live)
+        state, out = self._apply_core(state, b2)
+        out = out.replace(key=jnp.where(
+            out.valid, jnp.take(state["hkey"],
+                                jnp.clip(out.key, 0, K - 1)), out.key))
+        state = slot_directory_evict(
+            state, self._hot_target,
+            evictable=jnp.ones((K,), jnp.bool_),
+            discardable=jnp.all(state["score"] == TOPN_SENTINEL, axis=1),
+            pack_write=self._pack_write)
+        return state, out
+
+    def _host_shapes(self):
+        import jax as _jax
+        R, N = None, self.n
+        # shapes depend on the probe width — resolved lazily per call site
+        def shapes(r):
+            return [_jax.ShapeDtypeStruct((r,), jnp.bool_),
+                    _jax.ShapeDtypeStruct((r, N), jnp.int32),
+                    _jax.ShapeDtypeStruct((r, N), jnp.int32)]
+        return shapes
+
+    def _admit_write(self, out, widx, got, in_ob, oidx, host_res):
+        """Write admitted slots' leaderboard rows: the cold row (outbox
+        beats host — chronologically newer) or a fresh sentinel row."""
+        _found, h_score, h_tid = host_res
+        ob = in_ob[:, None]
+        row_s = jnp.where(ob, jnp.take(out["oscore"], oidx, axis=0),
+                          h_score)
+        row_t = jnp.where(ob, jnp.take(out["otid"], oidx, axis=0), h_tid)
+        cold = (in_ob | _found)[:, None]
+        row_s = jnp.where(cold, row_s, TOPN_SENTINEL)
+        row_t = jnp.where(cold, row_t, 0)
+        out["score"] = out["score"].at[widx].set(row_s, mode="drop")
+        out["tid"] = out["tid"].at[widx].set(row_t, mode="drop")
+        return out
+
+    def _pack_write(self, out, opos, perm, spill):
+        out["oscore"] = out["oscore"].at[opos].set(
+            jnp.take(out["score"], perm, axis=0), mode="drop")
+        out["otid"] = out["otid"].at[opos].set(
+            jnp.take(out["tid"], perm, axis=0), mode="drop")
+        return out
+
+    def _apply_core(self, state, batch: Batch):
+        K, N = self._slots, self.n
         refs = tuple_refs(batch)
         scores = jax.vmap(self.score_fn)(refs).astype(jnp.int32)
         keymat = ((batch.key[None, :]
@@ -115,12 +212,11 @@ class TopN(Basic_Operator):
                        axis=1)
         evict = state["evict"] + jnp.sum(filled + cands - kept)
         touched = jnp.any(keymat, axis=1)
-        state = {"score": new_score, "tid": new_tid, "evict": evict,
-                 "eos": state["eos"]}
+        state = dict(state, score=new_score, tid=new_tid, evict=evict)
         return state, self._rows(state, touched)
 
     def _rows(self, state, keep_key):
-        K, N = self.num_keys, self.n
+        K, N = self._slots, self.n
         flat = lambda a: a.reshape(K * N)
         keyv = jnp.repeat(jnp.arange(K, dtype=jnp.int32), N)
         rank = jnp.tile(jnp.arange(N, dtype=jnp.int32), K)
@@ -133,13 +229,60 @@ class TopN(Basic_Operator):
 
     def flush(self, state):
         import numpy as np
-        if state is None or int(np.asarray(state["eos"])):
+        if state is None:
             return state, None
-        state = dict(state)
-        state["eos"] = jnp.asarray(1, jnp.int32)
-        self.collect_stats(state)
-        return state, self._rows(state, jnp.ones((self.num_keys,),
-                                                 jnp.bool_))
+        K, N = self._slots, self.n
+        if not int(np.asarray(state["eos"])):
+            if self._tier is not None:
+                # settle first: leaderboards still in the spill outbox must
+                # reach the store before the cold drain waves below
+                state = self._tier.controller.settle(state)
+            state = dict(state)
+            state["eos"] = jnp.asarray(1, jnp.int32)
+            self.collect_stats(state)
+            if self._tier is None:
+                return state, self._rows(state, jnp.ones((K,), jnp.bool_))
+            # tiered: emit the HOT leaderboards (stale unadmitted slots
+            # excluded), remapped slot -> key; cold waves follow. Keys
+            # resident hot are remembered: the store may still hold a
+            # SUPERSEDED copy of them (re-admission does not remove — the
+            # one-tier-rule exception), which the waves must skip.
+            hkey = np.asarray(state["hkey"])
+            hused = np.asarray(state["hused"])
+            self._flush_exclude = set(hkey[hused].tolist())
+            out = self._rows(state, state["hused"])
+            return state, out.replace(key=jnp.where(
+                out.valid, jnp.take(jnp.asarray(state["hkey"]),
+                                    jnp.clip(out.key, 0, K - 1)), out.key))
+        if self._tier is None:
+            return state, None
+        # EOS drain waves: pop up to K cold keys per flush call (ascending
+        # key order — deterministic, and replay-safe: a restore rewinds the
+        # store manifest, so the waves re-derive) until the store is empty
+        excl = getattr(self, "_flush_exclude", set())
+        while True:
+            keys, cols = self._tier.store.pop_keys(K)
+            if len(keys) == 0:
+                return state, None
+            live = np.asarray([int(k) not in excl for k in keys], bool)
+            if live.any():
+                break
+        n = len(keys)
+        kv = np.zeros((K,), np.int32)
+        kv[:n] = keys.astype(np.int32)
+        sc = np.full((K, N), TOPN_SENTINEL, np.int32)
+        sc[:n] = np.where(live[:, None], cols["score"], TOPN_SENTINEL)
+        td = np.zeros((K, N), np.int32)
+        td[:n] = cols["tid"]
+        group = np.repeat(np.arange(K) < n, N)
+        out = Batch(
+            key=jnp.asarray(np.repeat(kv, N)),
+            id=jnp.asarray(td.reshape(K * N)),
+            ts=jnp.zeros((K * N,), jnp.int32),
+            payload={"score": jnp.asarray(sc.reshape(K * N)),
+                     "rank": jnp.tile(jnp.arange(N, dtype=jnp.int32), K)},
+            valid=jnp.asarray(group & (sc.reshape(K * N) != TOPN_SENTINEL)))
+        return state, out
 
     def collect_stats(self, state: Any = None) -> None:
         if state is None:
@@ -150,7 +293,17 @@ class TopN(Basic_Operator):
         if ev > self._evict_synced:
             _cstate.bump("topn_evictions", ev - self._evict_synced)
             self._evict_synced = ev
-        self._publish_stage_counters({"topn_evictions": ev})
+        counters = {"topn_evictions": ev}
+        if self._tier is not None:
+            counters.update(_tier_counters(state, self._tier))
+            counters["overflow_drops"] = int(np.asarray(state["ovf"]))
+        self._publish_stage_counters(counters)
+
+    def drop_counters(self, state: Any = None) -> dict:
+        if state is None or self._tier is None:
+            return {}
+        import numpy as np
+        return {"overflow_drops": int(np.asarray(state["ovf"]))}
 
     def event_time_stats(self, state: Any = None):
         """Watermark-map section: leaderboard fill + eviction pressure
@@ -159,11 +312,17 @@ class TopN(Basic_Operator):
             return None
         import numpy as np
         filled = int((np.asarray(state["score"]) != TOPN_SENTINEL).sum())
-        slots = self.num_keys * self.n
-        return {"leaderboard_slots": slots,
-                "leaderboard_filled": filled,
-                "occupancy_pct": round(100.0 * filled / slots, 2),
-                "topn_evictions": int(np.asarray(state["evict"]))}
+        slots = self._slots * self.n
+        out = {"leaderboard_slots": slots,
+               "leaderboard_filled": filled,
+               "occupancy_pct": round(100.0 * filled / slots, 2),
+               "topn_evictions": int(np.asarray(state["evict"]))}
+        if self._tier is not None:
+            from ..state.tiered import slot_directory_stats
+            out["tier"] = {**slot_directory_stats(state),
+                           **self._tier.controller.stats()}
+            out["overflow_drops"] = int(np.asarray(state["ovf"]))
+        return out
 
 
 class Distinct(Basic_Operator):
@@ -181,36 +340,74 @@ class Distinct(Basic_Operator):
     routing = routing_modes_t.KEYBY
 
     def __init__(self, value_fn: Optional[Callable] = None, *,
-                 num_slots: int = DEFAULT_MAX_KEYS, name: str = "distinct",
-                 parallelism: int = 1):
+                 num_slots: int = DEFAULT_MAX_KEYS, tiered=None,
+                 name: str = "distinct", parallelism: int = 1):
         super().__init__(name, parallelism)
         self.value_fn = value_fn or (lambda t: t.key)
         self.num_slots = int(num_slots)
         self._pending = None
+        # tiered keyed state (ROADMAP 3): the distinct table is a delay-0
+        # JoinTable, so it rides the same spill/readmit hooks
+        from ..state import TierConfig
+        self._tier_cfg = TierConfig.resolve(tiered)
+        self._tier = None
 
     def bind_geometry(self, batch_capacity: int) -> None:
         self._pending = int(batch_capacity)
 
     def init_state(self, payload_spec: Any):
         pending = self._pending or self.num_slots
-        return join_table_init(self.num_slots, pending,
-                               {"one": jax.ShapeDtypeStruct((), jnp.int32)})
+        vspec = {"one": jax.ShapeDtypeStruct((), jnp.int32)}
+        if self._tier_cfg is not None:
+            from ..state.tiered import JoinTableTier
+            hot = int(self._tier_cfg.hot_capacity or self.num_slots)
+            # delay-0 table: the ring empties every batch, so one batch of
+            # distinct keys is the per-batch admission bound
+            self._reserve = pending
+            self._hot_target = max(1, hot - self._reserve)
+            outbox = int(self._tier_cfg.outbox or 4 * self._reserve)
+            state = join_table_init(hot, pending, vspec)
+            state = join_table_tier_init(state, outbox, vspec)
+            self._tier = JoinTableTier(self.name, vspec, self._tier_cfg)
+            return state
+        return join_table_init(self.num_slots, pending, vspec)
+
+    def tier_controllers(self):
+        return (self._tier.controller,) if self._tier is not None else ()
 
     def apply(self, state, batch: Batch):
         refs = tuple_refs(batch)
         dk = jax.vmap(self.value_fn)(refs).astype(jnp.int32)
         firsts = batch.valid & (segment_rank(dk, batch.valid) == 0)
+        fb_ok = None
+        if self._tier is not None:
+            # miss -> readmit: a value seen long ago lives in the cold
+            # tier — resolve it back before the duplicate probe, so
+            # suppression is independent of tier placement
+            state, _fb_vals, fb_ok = join_table_tier_resolve(
+                state, dk, batch.valid, self._tier.lookup_cb)
         _, hit = join_table_probe(state, dk, firsts)
+        if fb_ok is not None:
+            # a seen-value whose row could not re-admit (saturated hot
+            # table) still counts as seen
+            hit = hit | (fb_ok & firsts)
         keep = firsts & ~hit
         ones = jnp.ones((batch.capacity,), jnp.int32)
         state = join_table_upsert(state, dk, {"one": ones}, batch.ts,
-                                  batch.id, keep, delay=0)
+                                  batch.id, keep, delay=0,
+                                  divert=self._tier is not None)
+        if self._tier is not None:
+            state = join_table_tier_touch(state, dk, batch.valid)
+            state = join_table_tier_evict(state, self._hot_target)
         return state, batch.mask(keep)
 
     def collect_stats(self, state: Any = None) -> None:
         if state is None:
             return
-        self._publish_stage_counters(self.drop_counters(state))
+        counters = dict(self.drop_counters(state))
+        if self._tier is not None:
+            counters.update(_tier_counters(state, self._tier))
+        self._publish_stage_counters(counters)
 
     def drop_counters(self, state: Any = None) -> dict:
         if state is None:
@@ -226,4 +423,7 @@ class Distinct(Basic_Operator):
         from ..ops.lookup import join_table_stats
         out = join_table_stats(state)
         out["delay"] = 0
+        if self._tier is not None:
+            out["tier"] = {**join_table_tier_stats(state),
+                           **self._tier.controller.stats()}
         return out

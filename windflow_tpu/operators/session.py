@@ -76,8 +76,8 @@ class SessionWindow(Basic_Operator):
 
     def __init__(self, value_fn: Callable, spec: WindowSpec, *,
                  combine: Callable = None, identity: Any = 0,
-                 num_keys: int = DEFAULT_MAX_KEYS, name: str = "session",
-                 parallelism: int = 1):
+                 num_keys: int = DEFAULT_MAX_KEYS, tiered=None,
+                 name: str = "session", parallelism: int = 1):
         super().__init__(name, parallelism)
         if not spec.is_session:
             raise ValueError(
@@ -91,6 +91,16 @@ class SessionWindow(Basic_Operator):
         self._cap: Optional[int] = None
         self._old_synced = 0
         self._closed_synced = 0
+        # tiered keyed state: a key -> hot-slot directory in front of the
+        # direct-indexed session table. OPEN sessions are PINNED hot (they
+        # must fire through the in-graph triggerer); only closed keys'
+        # floors/ordinals spill, and the watermark retires floors the
+        # lateness contract proves can never flag an OLD again
+        from ..state import TierConfig
+        self._tier_cfg = TierConfig.resolve(tiered)
+        self._tier = None
+        self._slots = (int(self._tier_cfg.hot_capacity or num_keys)
+                       if self._tier_cfg is not None else self.num_keys)
 
     # -- geometry / specs -------------------------------------------------
 
@@ -99,7 +109,7 @@ class SessionWindow(Basic_Operator):
 
     def out_capacity(self, in_capacity: int) -> int:
         # in-batch evidence closes (<= 2 row groups of C) + watermark closes
-        return 2 * in_capacity + self.num_keys
+        return 2 * in_capacity + self._slots
 
     def _val_spec(self, payload_spec):
         return jax.eval_shape(self.value_fn, _ref_spec(payload_spec))
@@ -110,7 +120,7 @@ class SessionWindow(Basic_Operator):
                 "start": i, "end": i, "n": i}
 
     def init_state(self, payload_spec: Any):
-        K = self.num_keys
+        K = self._slots
         vspec = self._val_spec(payload_spec)
         acc = jax.tree.map(
             lambda s: jnp.zeros((K,) + tuple(s.shape), s.dtype), vspec)
@@ -122,11 +132,38 @@ class SessionWindow(Basic_Operator):
                  "closed": jnp.asarray(0, jnp.int32),
                  "old": jnp.asarray(0, jnp.int32),
                  "eos": jnp.asarray(0, jnp.int32)}
+        if self._tier_cfg is not None:
+            from ..state.tiered import SlotTableTier, slot_directory_init
+            cap = self._cap or DEFAULT_MAX_KEYS
+            self._hot_target = max(1, K - min(cap, K - 1))
+            outbox = int(self._tier_cfg.outbox or 4 * cap)
+            state.update(slot_directory_init(K, outbox, {
+                "ofloor": lambda s: jnp.full((s,), _IMIN, jnp.int32),
+                "osid": lambda s: jnp.zeros((s,), jnp.int32)}))
+            state["ovf"] = jnp.asarray(0, jnp.int32)
+            gap, delay = self.spec.gap, self.spec.delay
+            self._tier = SlotTableTier(
+                self.name,
+                {"floor": (jnp.int32, ()), "sid": (jnp.int32, ())},
+                self._tier_cfg, count_key="ocnt",
+                col_keys=["okey", "otick", "ofloor", "osid"],
+                state_to_store=lambda n, host: (
+                    host["okey"], host["otick"],
+                    {"floor": host["ofloor"], "sid": host["osid"]}),
+                # retire floors once no admissible arrival can be OLD:
+                # floor + gap < wm - delay  =>  ts > floor + gap for every
+                # future tuple the lateness contract admits
+                compact_col="floor",
+                compact_bound=lambda wm: wm - delay - gap,
+                wm_key="wm")
         if self._event_time:
             # observed-lateness histogram (event-time monitoring only —
             # absent otherwise, so the off program is unchanged)
             state["lat_hist"] = _et.lateness_init()
         return state
+
+    def tier_controllers(self):
+        return (self._tier.controller,) if self._tier is not None else ()
 
     # -- the batched session step -----------------------------------------
 
@@ -135,7 +172,65 @@ class SessionWindow(Basic_Operator):
         return jax.tree.map(fn, a, b)
 
     def apply(self, state, batch: Batch):
-        K, C = self.num_keys, batch.capacity
+        if self._tier is None:
+            return self._apply_core(state, batch)
+        from ..ops.lookup import count_drops
+        from ..state.tiered import slot_directory_evict, \
+            slot_directory_resolve
+        K = self._slots
+        state, slot, live = slot_directory_resolve(
+            state, batch.key, batch.valid, self._tier.lookup_cb,
+            self._host_shapes, self._admit_write)
+        # a lane whose key found no hot slot (directory saturated with
+        # OPEN sessions) drops, counted — the untiered table would have
+        # silently mangled any key >= num_keys
+        state = dict(state, ovf=count_drops(
+            state["ovf"], "overflow_drops",
+            jnp.sum((batch.valid & ~live).astype(jnp.int32))))
+        b2 = batch.replace(key=jnp.where(live, slot, 0), valid=live)
+        state, out = self._apply_core(state, b2)
+        out = out.replace(key=jnp.where(
+            out.valid, jnp.take(state["hkey"],
+                                jnp.clip(out.key, 0, K - 1)), out.key))
+        # OPEN sessions are pinned hot — only closed keys' floors spill;
+        # floors with nothing to remember free without outbox space
+        state = slot_directory_evict(
+            state, self._hot_target,
+            evictable=~state["open"],
+            discardable=state["floor"] == _IMIN,
+            pack_write=self._pack_write)
+        return state, out
+
+    def _host_shapes(self, r):
+        return [jax.ShapeDtypeStruct((r,), jnp.bool_),
+                jax.ShapeDtypeStruct((r,), jnp.int32),
+                jax.ShapeDtypeStruct((r,), jnp.int32)]
+
+    def _admit_write(self, out, widx, got, in_ob, oidx, host_res):
+        """Write admitted keys' carried fields: the cold (floor, session
+        ordinal) pair — outbox beats host — or the fresh (_IMIN, 0)."""
+        _found, h_floor, h_sid = host_res
+        cold = in_ob | _found
+        floor = jnp.where(in_ob, jnp.take(out["ofloor"], oidx), h_floor)
+        sid = jnp.where(in_ob, jnp.take(out["osid"], oidx), h_sid)
+        floor = jnp.where(cold, floor, _IMIN)
+        sid = jnp.where(cold, sid, 0)
+        out["floor"] = out["floor"].at[widx].set(floor, mode="drop")
+        out["sid"] = out["sid"].at[widx].set(sid, mode="drop")
+        # an admitted slot starts closed (stale open slots are never
+        # evicted, so open is already False here by construction)
+        out["open"] = out["open"].at[widx].set(False, mode="drop")
+        return out
+
+    def _pack_write(self, out, opos, perm, spill):
+        out["ofloor"] = out["ofloor"].at[opos].set(
+            jnp.take(out["floor"], perm), mode="drop")
+        out["osid"] = out["osid"].at[opos].set(
+            jnp.take(out["sid"], perm), mode="drop")
+        return out
+
+    def _apply_core(self, state, batch: Batch):
+        K, C = self._slots, batch.capacity
         gap = self.spec.gap
         refs = tuple_refs(batch)
         vals = jax.vmap(self.value_fn)(refs)
@@ -229,14 +324,16 @@ class SessionWindow(Basic_Operator):
             (g1, fkey, g1_id, c_last, c_start, c_cnt, c_acc),
             (g2, fkey, g2_id, m_last, m_start, m_cnt, m_acc),
             (g3, sid2, last2, start2, cnt2, acc2))
-        new_state = {"open": open3, "start": start2, "last": last2,
-                     "cnt": cnt2, "sid": sid3, "acc": acc2, "floor": floor3,
-                     "wm": wm2,
-                     "closed": state["closed"] + jnp.sum(g1.astype(jnp.int32))
-                     + jnp.sum(g2.astype(jnp.int32))
-                     + jnp.sum(g3.astype(jnp.int32)),
-                     "old": state["old"] + jnp.sum(old.astype(jnp.int32)),
-                     "eos": state["eos"]}
+        from ..ops.lookup import count_drops
+        new_state = dict(
+            state, open=open3, start=start2, last=last2,
+            cnt=cnt2, sid=sid3, acc=acc2, floor=floor3,
+            wm=wm2,
+            closed=state["closed"] + jnp.sum(g1.astype(jnp.int32))
+            + jnp.sum(g2.astype(jnp.int32))
+            + jnp.sum(g3.astype(jnp.int32)),
+            old=count_drops(state["old"], "old_drops",
+                            jnp.sum(old.astype(jnp.int32))))
         if self._event_time:
             # arrival lateness vs the post-batch watermark: one masked
             # reduction, state-only (results untouched).  delay >= the
@@ -267,7 +364,7 @@ class SessionWindow(Basic_Operator):
         import numpy as np
         if state is None or int(np.asarray(state["eos"])):
             return state, None
-        K = self.num_keys
+        K = self._slots
         C = self._cap or K
         g3 = state["open"]
         z = jnp.zeros((C,), jnp.int32)
@@ -279,6 +376,12 @@ class SessionWindow(Basic_Operator):
             (zb, z, z, z, z, z, zacc), (zb, z, z, z, z, z, zacc),
             (g3, state["sid"], state["last"], state["start"], state["cnt"],
              state["acc"]))
+        if self._tier is not None:
+            # open sessions are pinned hot, so the EOS fire covers every
+            # live session — emitted slot ids remap to their true keys
+            out = out.replace(key=jnp.where(
+                out.valid, jnp.take(state["hkey"],
+                                    jnp.clip(out.key, 0, K - 1)), out.key))
         state = dict(state)
         state["closed"] = state["closed"] + jnp.sum(g3.astype(jnp.int32))
         state["sid"] = state["sid"] + g3.astype(jnp.int32)
@@ -298,14 +401,21 @@ class SessionWindow(Basic_Operator):
         if closed > self._closed_synced:
             _cstate.bump("sessions_closed", closed - self._closed_synced)
             self._closed_synced = closed
-        self._publish_stage_counters({"sessions_closed": closed,
-                                      "old_drops": old})
+        counters = {"sessions_closed": closed, "old_drops": old}
+        if self._tier is not None:
+            from .join import _tier_counters
+            counters.update(_tier_counters(state, self._tier))
+            counters["overflow_drops"] = int(np.asarray(state["ovf"]))
+        self._publish_stage_counters(counters)
 
     def drop_counters(self, state: Any = None) -> dict:
         if state is None:
             return {}
         import numpy as np
-        return {"old_drops": int(np.asarray(state["old"]))}
+        out = {"old_drops": int(np.asarray(state["old"]))}
+        if self._tier is not None:
+            out["overflow_drops"] = int(np.asarray(state["ovf"]))
+        return out
 
     def event_time_stats(self, state: Any = None):
         """Watermark-map section: open-session pressure (count + oldest-open
@@ -322,11 +432,16 @@ class SessionWindow(Basic_Operator):
             "gap": self.spec.gap,
             "delay": self.spec.delay,
             "open_sessions": n_open,
-            "key_slots": self.num_keys,
-            "occupancy_pct": round(100.0 * n_open / self.num_keys, 2),
+            "key_slots": self._slots,
+            "occupancy_pct": round(100.0 * n_open / self._slots, 2),
             "sessions_closed": int(np.asarray(state["closed"])),
             "old_drops": int(np.asarray(state["old"])),
         }
+        if self._tier is not None:
+            from ..state.tiered import slot_directory_stats
+            out["tier"] = {**slot_directory_stats(state),
+                           **self._tier.controller.stats()}
+            out["overflow_drops"] = int(np.asarray(state["ovf"]))
         if n_open:
             # age of the longest-open session: how much event time the
             # watermark has advanced past its first event

@@ -308,7 +308,7 @@ def join_table_init(num_slots: int, pending: int, val_spec) -> dict:
 
 def join_table_upsert(state: dict, key: jax.Array, val, ts: jax.Array,
                       tid: jax.Array, ok: jax.Array, *,
-                      delay: int = 0) -> dict:
+                      delay: int = 0, divert: bool = False) -> dict:
     """Buffer the batch's build-side tuples and apply every upsert the
     watermark has made eligible (``ts <= wm - delay``). Fixed-shape, fully
     vectorized (no serial per-row loop): per-key last-writer-wins is ONE
@@ -336,7 +336,8 @@ def join_table_upsert(state: dict, key: jax.Array, val, ts: jax.Array,
     csum = jnp.cumsum(ok.astype(jnp.int32))
     pos = cnt + csum - 1
     keep = ok & (pos < P)
-    dropped = state["dropped"] + jnp.sum((ok & ~keep).astype(jnp.int32))
+    dropped = count_drops(state["dropped"], "overflow_drops",
+                          jnp.sum((ok & ~keep).astype(jnp.int32)))
     slot = jnp.where(keep, pos, P)
     arrive = state["seq"] + csum - 1
     pkey = state["pkey"].at[slot].set(key, mode="drop")
@@ -391,7 +392,22 @@ def join_table_upsert(state: dict, key: jax.Array, val, ts: jax.Array,
     oh = free[None, :] & (free_rank[None, :] == rnk[:, None])        # [P, K]
     got_new = jnp.any(oh, axis=1)
     slot_new = jnp.argmax(oh, axis=1)
-    dropped = dropped + jnp.sum((need_new & ~got_new).astype(jnp.int32))
+    lost = need_new & ~got_new
+    if divert:
+        # tiered table, saturated: the winning upsert is NOT lost — it is
+        # diverted straight to the cold tier through the spill outbox (its
+        # version triplet rides along, so cross-tier LWW stays exact); only
+        # outbox exhaustion still drops, and that is counted
+        S_ob = state["okey"].shape[0]
+        drank = jnp.cumsum(lost.astype(jnp.int32)) - 1
+        fits = lost & (state["ocnt"] + drank < S_ob)
+        div_pos = jnp.where(fits, state["ocnt"] + drank, S_ob)
+        div_n = jnp.sum(fits.astype(jnp.int32))
+        dropped = count_drops(dropped, "overflow_drops",
+                              jnp.sum((lost & ~fits).astype(jnp.int32)))
+    else:
+        dropped = count_drops(dropped, "overflow_drops",
+                              jnp.sum(lost.astype(jnp.int32)))
 
     # 5. never roll back: the pending version must beat the slot's applied one
     beats = lex_gt(state["ver"][slot_old], state["vid"][slot_old],
@@ -407,6 +423,16 @@ def join_table_upsert(state: dict, key: jax.Array, val, ts: jax.Array,
     out["val"] = jax.tree.map(lambda t, v: t.at[widx].set(v, mode="drop"),
                               state["val"], pval)
     out["version"] = state["version"] + jnp.sum(write.astype(jnp.int32))
+    if divert:
+        out["okey"] = state["okey"].at[div_pos].set(pkey, mode="drop")
+        out["oval"] = jax.tree.map(
+            lambda t, v: t.at[div_pos].set(v, mode="drop"),
+            state["oval"], pval)
+        out["over"] = state["over"].at[div_pos].set(pts, mode="drop")
+        out["ovid"] = state["ovid"].at[div_pos].set(pid, mode="drop")
+        out["ovseq"] = state["ovseq"].at[div_pos].set(pseq, mode="drop")
+        out["ocnt"] = state["ocnt"] + div_n
+        out["spills"] = state["spills"] + div_n
 
     # 6. every eligible entry leaves the ring; recompact survivors (stable)
     pok2 = pok & ~elig
@@ -474,6 +500,252 @@ def join_table_stats(state: dict) -> dict:
         "pending_depth": pending,
         "pending_capacity": P,
         "overflow_drops": int(np.asarray(state["dropped"])),
+    }
+
+
+# ---------------------------------------------------- tiered state hooks
+
+def count_drops(counter: jax.Array, name: str, n) -> jax.Array:
+    """THE shared drop-accounting helper: every stateful operator's drop
+    path (JoinTable ``overflow_drops``, IntervalJoin ``arch_drops``/
+    ``match_drops``, session/TopN overflow + OLD drops, and the tiered
+    admission-overflow paths) adds through here, so tiered and untiered
+    counters can never fork names — ``name`` is validated against the
+    ``observability/names.py::STAGE_COUNTERS`` registry at TRACE time (a
+    typo'd counter fails the first compile, not a dashboard)."""
+    from ..observability.names import STAGE_COUNTERS
+    if name not in STAGE_COUNTERS:
+        raise ValueError(
+            f"count_drops: {name!r} is not registered in observability/"
+            f"names.py::STAGE_COUNTERS — register it there (the emission "
+            f"registries the linter gates)")
+    return counter + n
+
+
+def join_table_tier_init(state: dict, outbox: int, val_spec) -> dict:
+    """Grow a :func:`join_table_init` state with the tiered-state fields:
+    per-key last-access positions (``lap``/``tick`` — the PositionBucket
+    convention: batch positions, never wall clock), the bounded spill
+    outbox (``okey``/``oval``/``over``/``ovid``/``ovseq``/``ocnt``), and
+    the device-side movement counters. Only ever called with ``tiered=``
+    on — the OFF state pytree (and therefore every compiled program and
+    checkpoint layout) is byte-for-byte unchanged."""
+    imin = jnp.iinfo(jnp.int32).min
+    K = state["key"].shape[0]
+    S = int(outbox)
+    if S < 1:
+        raise ValueError("join_table_tier_init: outbox must be >= 1")
+
+    def zcol(n):
+        return jax.tree.map(
+            lambda s: jnp.zeros((n,), getattr(s, "dtype",
+                                              jnp.result_type(s))), val_spec)
+    out = dict(state)
+    out["lap"] = jnp.zeros((K,), jnp.int32)
+    out["tick"] = jnp.asarray(0, jnp.int32)
+    out["okey"] = jnp.full((S,), JOIN_KEY_SENTINEL, jnp.int32)
+    out["oval"] = zcol(S)
+    out["over"] = jnp.full((S,), imin, jnp.int32)
+    out["ovid"] = jnp.full((S,), imin, jnp.int32)
+    out["ovseq"] = jnp.full((S,), imin, jnp.int32)
+    out["ocnt"] = jnp.asarray(0, jnp.int32)
+    out["spills"] = jnp.asarray(0, jnp.int32)
+    out["readmits"] = jnp.asarray(0, jnp.int32)
+    return out
+
+
+def _outbox_find(state: dict, keys: jax.Array, need: jax.Array):
+    """Newest spill-outbox entry per wanted key: ``(found [R], clamped
+    index [R])`` — appends are chronological, so max index = newest."""
+    S = state["okey"].shape[0]
+    olive = jnp.arange(S, dtype=jnp.int32) < state["ocnt"]
+    eq = (keys[:, None] == state["okey"][None, :]) & olive[None, :]
+    oidx = jnp.max(jnp.where(eq, jnp.arange(S, dtype=jnp.int32)[None, :],
+                             -1), axis=1)
+    return need & (oidx >= 0), jnp.maximum(oidx, 0)
+
+
+def join_table_tier_fallback(state: dict, keys: jax.Array,
+                             miss: jax.Array) -> tuple:
+    """Post-upsert read fallback: a probe lane that still misses the hot
+    table reads the NEWEST outbox entry of its key (covers upserts the
+    saturated table diverted cold THIS batch, plus evicted rows whose
+    spill has not settled) — the last link making probe results
+    independent of tier placement. Returns ``(vals [R] pytree, hit [R])``."""
+    keys = keys.astype(jnp.int32)
+    hit, idx = _outbox_find(state, keys, miss.astype(jnp.bool_)
+                            & (keys != JOIN_KEY_SENTINEL))
+    vals = jax.tree.map(lambda leaf: jnp.take(leaf, idx, axis=0),
+                        state["oval"])
+    return vals, hit
+
+
+def join_table_tier_resolve(state: dict, keys: jax.Array, ok: jax.Array,
+                            lookup_cb) -> tuple:
+    """The miss -> readmit round of a tiered table, INSIDE the compiled
+    program so probe results are independent of tier placement: every
+    wanted key missing from the hot table is searched in the spill outbox
+    (newest entry wins — entries still in flight to the host store live
+    here, which is what makes the async spill lossless), then in the host
+    store through ONE ordered ``io_callback`` (``lookup_cb``), and found
+    rows are re-admitted through the deterministic fresh-slot discipline
+    the JoinTable already uses (the r-th readmitted key claims the r-th
+    free slot). Hot hits are touched (``lap = tick``).
+
+    Returns ``(state, fb_vals, fb_ok)`` — per-lane fallback values for the
+    oversubscription corner where a row's value is known but no hot slot
+    was free (the caller patches probe misses with them, so even a
+    saturated hot table never *mis-reads*; only upserts can drop, and
+    those are counted)."""
+    from jax.experimental import io_callback
+    from .segment import segment_rank
+    R = keys.shape[0]
+    K = state["key"].shape[0]
+    S = state["okey"].shape[0]
+    keys = keys.astype(jnp.int32)
+    ok = ok.astype(jnp.bool_) & (keys != JOIN_KEY_SENTINEL)
+    tick = state["tick"]
+    leaves, treedef = jax.tree.flatten(state["val"])
+
+    # hot-table search + last-access touch for every present key
+    tk = jnp.where(state["used"], state["key"], JOIN_KEY_SENTINEL)
+    eq = keys[:, None] == tk[None, :]                       # [R, K]
+    in_tab = jnp.any(eq, axis=1) & ok
+    slot_tab = jnp.argmax(eq, axis=1)
+    lap = state["lap"].at[
+        jnp.where(in_tab, slot_tab, K)].set(tick, mode="drop")
+    need = ok & ~in_tab
+    # spill-outbox search: the NEWEST entry of a key wins (a key evicted,
+    # readmitted, and evicted again within one un-drained window has two
+    # outbox entries; appends are chronological, so max index = newest)
+    in_ob, oidxc = _outbox_find(state, keys, need)
+    ob_leaves = [jnp.take(leaf, oidxc, axis=0)
+                 for leaf in jax.tree.leaves(state["oval"])]
+    ob_m = (jnp.take(state["over"], oidxc), jnp.take(state["ovid"], oidxc),
+            jnp.take(state["ovseq"], oidxc))
+    # cold-tier lookup: ONE ordered host callback for the still-missing
+    # keys (ordered => scan-fused dispatch and supervised replay walk the
+    # identical sequence; an all-False mask is a host no-op, so warm()'s
+    # functional dry-runs never touch the store). Duplicate lanes look up
+    # independently (same row) — only ADMISSION dedups.
+    need_host = need & ~in_ob
+    shapes = ([jax.ShapeDtypeStruct((R,), jnp.bool_)]
+              + [jax.ShapeDtypeStruct((R,), jnp.int32)] * 3
+              + [jax.ShapeDtypeStruct((R,), leaf.dtype) for leaf in leaves])
+    res = io_callback(lookup_cb, shapes, keys, need_host, ordered=True)
+    found = res[0] & need_host
+    hm = res[1:4]
+    h_leaves = list(res[4:])
+    # merge the two cold sources (outbox beats host: outbox entries are
+    # chronologically newer than everything already applied to the store)
+    fb_ok = in_ob | found
+    mrg = lambda o, h: jnp.where(in_ob, o, h)
+    adm_leaves = [jnp.where(in_ob, o, h).astype(o.dtype)
+                  for o, h in zip(ob_leaves, h_leaves)]
+    m0, m1, m2 = (mrg(ob_m[0], hm[0]), mrg(ob_m[1], hm[1]),
+                  mrg(ob_m[2], hm[2]))
+    # deterministic fresh-slot re-admission (the join_table_upsert rule:
+    # r-th readmitted key -> r-th free slot, ascending slot index); one
+    # slot per DISTINCT key — duplicate lanes ride the first occurrence
+    adm = fb_ok & (segment_rank(keys, fb_ok) == 0)
+    rank = jnp.cumsum(adm.astype(jnp.int32)) - 1
+    free = ~state["used"]
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    oh3 = free[None, :] & (free_rank[None, :] == rank[:, None])   # [R, K]
+    got = jnp.any(oh3, axis=1) & adm
+    widx = jnp.where(got, jnp.argmax(oh3, axis=1), K)
+    out = dict(state)
+    out["key"] = state["key"].at[widx].set(keys, mode="drop")
+    out["val"] = jax.tree.unflatten(treedef, [
+        t.at[widx].set(v, mode="drop")
+        for t, v in zip(jax.tree.leaves(state["val"]), adm_leaves)])
+    out["ver"] = state["ver"].at[widx].set(m0, mode="drop")
+    out["vid"] = state["vid"].at[widx].set(m1, mode="drop")
+    out["vseq"] = state["vseq"].at[widx].set(m2, mode="drop")
+    out["used"] = state["used"].at[widx].set(True, mode="drop")
+    out["lap"] = lap.at[widx].set(tick, mode="drop")
+    out["readmits"] = state["readmits"] + jnp.sum(got.astype(jnp.int32))
+    fb_vals = jax.tree.unflatten(treedef, adm_leaves)
+    return out, fb_vals, fb_ok
+
+
+def join_table_tier_touch(state: dict, keys: jax.Array,
+                          ok: jax.Array) -> dict:
+    """Refresh last-access positions for a batch's keys AFTER the upsert
+    applied (fresh upserts claimed new slots the resolve pass could not
+    see) — one compare + scatter, the access half of the eviction policy."""
+    K = state["key"].shape[0]
+    keys = keys.astype(jnp.int32)
+    tk = jnp.where(state["used"], state["key"], JOIN_KEY_SENTINEL)
+    eq = keys[:, None] == tk[None, :]
+    hit = jnp.any(eq, axis=1) & ok.astype(jnp.bool_) \
+        & (keys != JOIN_KEY_SENTINEL)
+    idx = jnp.where(hit, jnp.argmax(eq, axis=1), K)
+    out = dict(state)
+    out["lap"] = state["lap"].at[idx].set(state["tick"], mode="drop")
+    return out
+
+
+def join_table_tier_evict(state: dict, hot_target: int) -> dict:
+    """Pressure eviction — the deterministic tier-assignment policy: when
+    occupancy exceeds ``hot_target``, the coldest ``used - hot_target``
+    keys (ordered by last-access position, slot index breaking ties) are
+    packed into the spill outbox and their slots freed, bounded by the
+    outbox's free space. A pure function of (occupancy, last-access
+    positions) — never wall clock — so supervised replay re-derives
+    identical tier assignments. Closes the batch by advancing ``tick``."""
+    imax = jnp.iinfo(jnp.int32).max
+    K = state["key"].shape[0]
+    S = state["okey"].shape[0]
+    used = state["used"]
+    used_n = jnp.sum(used.astype(jnp.int32))
+    free_ob = S - state["ocnt"]
+    need = jnp.clip(used_n - jnp.asarray(int(hot_target), jnp.int32),
+                    0, free_ob)
+    sortkey = jnp.where(used, state["lap"], imax)
+    perm = jnp.lexsort((jnp.arange(K, dtype=jnp.int32), sortkey))
+    r = jnp.arange(K, dtype=jnp.int32)
+    sel = (r < need) & jnp.take(used, perm)
+    opos = jnp.where(sel, state["ocnt"] + r, S)
+    out = dict(state)
+    out["okey"] = state["okey"].at[opos].set(jnp.take(state["key"], perm),
+                                             mode="drop")
+    out["oval"] = jax.tree.map(
+        lambda t, src: t.at[opos].set(jnp.take(src, perm, axis=0),
+                                      mode="drop"),
+        state["oval"], state["val"])
+    out["over"] = state["over"].at[opos].set(jnp.take(state["ver"], perm),
+                                             mode="drop")
+    out["ovid"] = state["ovid"].at[opos].set(jnp.take(state["vid"], perm),
+                                             mode="drop")
+    out["ovseq"] = state["ovseq"].at[opos].set(jnp.take(state["vseq"], perm),
+                                               mode="drop")
+    cleared = jnp.where(sel, perm, K)
+    out["used"] = used.at[cleared].set(False, mode="drop")
+    out["key"] = out["key"].at[cleared].set(JOIN_KEY_SENTINEL, mode="drop")
+    n = jnp.sum(sel.astype(jnp.int32))
+    out["ocnt"] = state["ocnt"] + n
+    out["spills"] = state["spills"] + n
+    out["tick"] = state["tick"] + 1
+    return out
+
+
+def join_table_tier_stats(state: dict) -> dict:
+    """Device-side tier numbers beside :func:`join_table_stats` (snapshot
+    time only): hot occupancy, outbox depth, and the spill/readmit
+    movement counters carried in the state pytree."""
+    import numpy as np
+    K = int(state["key"].shape[0])
+    S = int(state["okey"].shape[0])
+    used = int(np.asarray(state["used"]).sum())
+    return {
+        "hot_slots": K,
+        "hot_used": used,
+        "hot_pct": round(100.0 * used / K, 2),
+        "outbox_slots": S,
+        "outbox_depth": int(np.asarray(state["ocnt"])),
+        "state_spills": int(np.asarray(state["spills"])),
+        "state_readmits": int(np.asarray(state["readmits"])),
     }
 
 

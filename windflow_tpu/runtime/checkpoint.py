@@ -45,6 +45,12 @@ from .pipeline import CompiledChain
 #: reserved __meta__ keys (stripped from the dict load_chain returns)
 _META_SHA = "__sha256__"
 _META_SEQ = "__seq__"
+#: per-op state-leaf KEY PATHS (jax.tree_util.keystr), written by every
+#: save: restore matches leaves BY PATH, so a state layout that grew
+#: interleaved fields (the tiered-state lap/ocnt/okey/... keys sort into
+#: the middle of the dict flatten order) restores old leaves into the
+#: right fields instead of positionally misassigning them
+_META_PATHS = "__leafpaths__"
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -69,6 +75,25 @@ def _flatten(states) -> Dict[str, np.ndarray]:
         leaves, _ = jax.tree.flatten(st)
         for j, leaf in enumerate(leaves):
             out[f"op{i}_leaf{j}"] = np.asarray(leaf)
+    return out
+
+
+def _chain_arrays(chain: CompiledChain) -> Dict[str, np.ndarray]:
+    """Device states + (for tiered operators) the settled cold-tier
+    manifests — ONE array namespace, so the per-array sha256 map and the
+    atomic write cover the host stores exactly like device state."""
+    chain.tier_settle()
+    out = _flatten(chain.states)
+    out.update(chain.tier_manifests())
+    return out
+
+
+def _leaf_paths(states) -> Dict[str, list]:
+    """``{"op<i>": [keystr, ...]}`` of every state leaf, in flatten order."""
+    out = {}
+    for i, st in enumerate(states):
+        kl, _ = jax.tree_util.tree_flatten_with_path(st)
+        out[f"op{i}"] = [jax.tree_util.keystr(p) for p, _leaf in kl]
     return out
 
 
@@ -177,9 +202,10 @@ def save_chain(chain: CompiledChain, path: str, *, meta: dict = None,
     whole-file sha256; pruned to the last ``keep`` files). ``load_chain`` on
     the same ``path`` then restores the newest valid entry."""
     path = resolve_path(path)
-    arrays = _flatten(chain.states)
+    arrays = _chain_arrays(chain)
     full_meta = dict(meta or {})
     full_meta[_META_SHA] = _digest_map(arrays)
+    full_meta[_META_PATHS] = _leaf_paths(chain.states)
     spec = _faults.decision("checkpoint.save", path=path)
     if keep <= 1:
         raw = _to_npz_bytes(_serialize(arrays, full_meta))
@@ -247,6 +273,7 @@ def _restore_file(chain: CompiledChain, path: str,
     raw = data.get("__meta__")
     meta = json.loads(bytes(raw).decode()) if raw is not None else {}
     sha_map = meta.pop(_META_SHA, None)
+    paths_map = meta.pop(_META_PATHS, None)
     meta.pop(_META_SEQ, None)
     present = set(getattr(data, "files", []))
     if sha_map:
@@ -259,6 +286,48 @@ def _restore_file(chain: CompiledChain, path: str,
     new_states = []
     for i, st in enumerate(chain.states):
         leaves, treedef = jax.tree.flatten(st)
+        saved_paths = (paths_map or {}).get(f"op{i}")
+        if saved_paths is not None:
+            # path-aware restore (every modern save): match leaves BY KEY
+            # PATH, so a layout that grew interleaved fields (tiered-state
+            # lap/okey/... sort into the middle of the dict flatten order)
+            # restores each saved leaf into its true field. Fields absent
+            # from the file keep their fresh init (tier fields restoring a
+            # pre-tiering save); saved fields the chain lacks are skipped
+            # (the legacy trailing-leaf tolerance, by name: an event_time-
+            # off or untiered chain restoring a richer save keeps exactly
+            # the fields it has)
+            kl, _ = jax.tree_util.tree_flatten_with_path(st)
+            cur = [jax.tree_util.keystr(p) for p, _leaf in kl]
+            idx = {p: j for j, p in enumerate(saved_paths)}
+            # the positional branch's trailing-tolerance, kept by saved
+            # index: a missing TRAILING run of saved arrays is the legacy
+            # grown-field case (those fields keep their init); a GAP is a
+            # mismatched/tampered file and stays a loud error
+            have = [f"op{i}_leaf{j}" in present
+                    for j in range(len(saved_paths))]
+            n_present = sum(have)
+            if have[n_present:] != [False] * (len(saved_paths) - n_present):
+                j_bad = have.index(False)
+                raise KeyError(
+                    f"checkpoint {path!r} is missing op{i}_leaf{j_bad} "
+                    f"({saved_paths[j_bad]}) but has later leaves of "
+                    f"op{i} — mismatched chain or truncated file")
+            restored = [
+                jax.numpy.asarray(data[f"op{i}_leaf{idx[p]}"])
+                if p in idx and have[idx[p]] else leaves[j]
+                for j, p in enumerate(cur)]
+            new_states.append(jax.tree.unflatten(treedef, restored))
+            continue
+        # legacy file (no path map): positional restore. Refuse it for a
+        # tiered operator — the tier fields interleave into the flatten
+        # order, so positional matching would silently misassign arrays
+        if any(j == i for j in getattr(chain, "_tier_ops", ())):
+            raise KeyError(
+                f"checkpoint {path!r} predates leaf-path metadata and "
+                f"op{i} has tiered state — a positional restore would "
+                f"misassign fields; re-save the checkpoint (or restore "
+                f"into an untiered chain first)")
         have = [f"op{i}_leaf{j}" in present for j in range(len(leaves))]
         # only a missing TRAILING suffix of a present state is the legacy
         # grown-field case; a gap (missing leaf followed by a present one) or
@@ -278,6 +347,11 @@ def _restore_file(chain: CompiledChain, path: str,
                     else leaves[j] for j in range(len(leaves))]
         new_states.append(jax.tree.unflatten(treedef, restored))
     chain.states = new_states
+    # tiered cold tiers: restore from the tier* namespace (a pre-tiering
+    # checkpoint has none — the fresh empty store stands, and any in-flight
+    # spill copies of the failed attempt are discarded either way)
+    chain.tier_restore_manifests(
+        {k: data[k] for k in present if k.startswith("tier")})
     return meta
 
 

@@ -146,6 +146,12 @@ class CompiledChain:
         self.states = [op.init_state(self.specs[i]) for i, op in enumerate(self.ops)]
         if self.device is not None:
             self.states = [jax.device_put(s, self.device) for s in self.states]
+        #: operators with tiered keyed state (state/tiered.py): their
+        #: controllers' maintain runs after every push — the async
+        #: HBM->host spill settle point. Empty (one falsy check per push)
+        #: unless some operator was built with tiered= on.
+        self._tier_ops = [j for j, op in enumerate(self.ops)
+                          if op.tier_controllers()]
         self._steps = {}
         self._push_count = 0
         self._fused_count = 0       # push_many launches (scan dispatch)
@@ -310,6 +316,83 @@ class CompiledChain:
         finally:
             hl._suppress(False)
 
+    # -- tiered keyed state (state/tiered.py) -------------------------------
+
+    def _tier_maintain(self) -> None:
+        """Per-push maintenance of every tiered operator: advance the async
+        spill pipeline (start/consume ``copy_to_host_async`` copies, apply
+        settled prefixes to the host stores, one cached clear executable
+        when a prefix settled) + the compaction cadence. Called by
+        ``push``/``push_many`` right after the state update — the cadence
+        is therefore a pure function of stream position, so supervised
+        replay re-walks it exactly."""
+        for j in self._tier_ops:
+            st = self.states[j]
+            for t in self.ops[j].tier_controllers():
+                st = t.maintain(st)
+            self.states[j] = st
+
+    def tier_settle(self) -> None:
+        """Synchronously drain every tiered operator's spill outbox into
+        its host store and drop in-flight copies — the pre-snapshot
+        barrier (supervised snapshots settle first, so a checkpoint always
+        captures a consistent (state, store) pair)."""
+        for j in self._tier_ops:
+            st = self.states[j]
+            for t in self.ops[j].tier_controllers():
+                st = t.settle(st)
+            self.states[j] = st
+
+    def tier_snapshot(self):
+        """Host-memory copies of every tiered operator's cold tier (after
+        :meth:`tier_settle` — callers settle first); None when no operator
+        is tiered."""
+        if not self._tier_ops:
+            return None
+        return {j: [t.manifest() for t in self.ops[j].tier_controllers()]
+                for j in self._tier_ops}
+
+    def tier_restore(self, snap) -> None:
+        """Restore the cold tiers from a :meth:`tier_snapshot`; in-flight
+        spill copies of the failed attempt are discarded (the restored
+        device states still hold those rows in their outboxes — replay
+        re-derives the spill)."""
+        for j in self._tier_ops:
+            ctls = self.ops[j].tier_controllers()
+            mans = (snap or {}).get(j)
+            for i, t in enumerate(ctls):
+                if mans is not None and i < len(mans):
+                    t.restore(mans[i])
+                else:
+                    t.discard_inflight()
+
+    def tier_manifests(self) -> dict:
+        """Flat ``{"tier<op>_<ctl>_<name>": np.ndarray}`` map of every cold
+        tier — the checkpoint-file representation (``runtime/checkpoint.py``
+        stores these beside the ``op<i>_leaf<j>`` state arrays, covered by
+        the same per-array sha256)."""
+        out = {}
+        for j in self._tier_ops:
+            for i, t in enumerate(self.ops[j].tier_controllers()):
+                for k, v in t.manifest().items():
+                    out[f"tier{j}_{i}_{k}"] = v
+        return out
+
+    def tier_restore_manifests(self, arrays: dict) -> None:
+        """Restore cold tiers from checkpoint arrays (the
+        :meth:`tier_manifests` layout). A checkpoint written before an
+        operator was tiered simply has no ``tier*`` keys — the fresh empty
+        store stands (the legacy grown-field stance of ``load_chain``)."""
+        for j in self._tier_ops:
+            for i, t in enumerate(self.ops[j].tier_controllers()):
+                prefix = f"tier{j}_{i}_"
+                man = {k[len(prefix):]: v for k, v in arrays.items()
+                       if k.startswith(prefix)}
+                if man:
+                    t.restore(man)
+                else:
+                    t.discard_inflight()
+
     def state_footprints(self) -> dict:
         """Per-operator state-pytree footprint in bytes, from static
         shape/dtype metadata (the specs bound at construction — no device
@@ -384,6 +467,8 @@ class CompiledChain:
             # compile event can never inflate the service sample
             self._health_end(hl, t0c, from_op, "scan", stacked)
         self.states = list(states)
+        if self._tier_ops:
+            self._tier_maintain()
         if sampled:
             # the fused launch is already synced: fold the event-time drop
             # readback into it (coordinates = the group's first traced batch)
@@ -462,6 +547,8 @@ class CompiledChain:
             # compile event can never inflate the service sample
             self._health_end(hl, t0c, from_op, "step", batch)
         self.states = list(states)
+        if self._tier_ops:
+            self._tier_maintain()
         if sampled:
             # the sampled push already paid the block_until_ready: fold the
             # event-time drop readback (lateness_drop journal events carrying
@@ -517,6 +604,10 @@ class CompiledChain:
         """Pull device-resident stats counters (e.g. window OLD-drop counts)
         into every operator's host Stats_Record — called at EOS and by the
         metrics registry at snapshot time."""
+        if self._tier_ops:
+            # EOS barrier: in-flight spills settle so the final counters /
+            # tier sections (and any following checkpoint) are consistent
+            self.tier_settle()
         for op, st in zip(self.ops, self.states):
             op.collect_stats(st)
         self._journal_drops(None)

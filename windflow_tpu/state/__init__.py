@@ -1,0 +1,17 @@
+"""Tiered keyed state — million-key tables over fixed-capacity HBM tables.
+
+The two-tier state layer of ROADMAP item 3: every stateful operator keeps
+its hot set device-resident at today's geometry while cold keys live in a
+host-side :class:`HostStore`, moved by the :class:`TieredTable` controller
+with async spills (``copy_to_host_async``), probe-miss re-admission
+(ordered ``io_callback``), and watermark compaction. Off by default behind
+the ``tiered=`` kwarg / ``WF_STATE_TIERED`` env (the ``kwarg=``/``WF_*``
+convention); the OFF path is byte-for-byte today's programs.
+
+See ``docs/ARCHITECTURE.md`` §18 for the protocol and determinism contract.
+"""
+
+from .host_store import HostStore
+from .tiered import TierConfig, TieredTable
+
+__all__ = ["HostStore", "TierConfig", "TieredTable"]

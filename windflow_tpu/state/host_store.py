@@ -1,0 +1,334 @@
+"""Cold-tier keyed store — the host side of the two-tier state layer.
+
+The HBM tables of the stateful operators (``ops/lookup.py`` JoinTable,
+session open-table, top-N leaderboards, interval-join archives) are
+fixed-capacity: production key cardinalities are millions, the tables are
+thousands. The :class:`HostStore` is where cold keys live between touches —
+plain numpy dict-of-arrays (the portable-primitive stance of
+arXiv:2603.18695: the store is generic over *columns*, never over operator
+types), touched only at the edges of the device program:
+
+- **spill in** (``upsert``/``append``): applied by the
+  :class:`~windflow_tpu.state.tiered.TieredTable` settle point from the
+  async-copied device outbox — never on the hot path;
+- **re-admission out** (``lookup``/``fetch_multi``): called from the
+  operators' ordered ``io_callback`` when a device probe misses all
+  device-resident tiers;
+- **watermark compaction** (``compact_below``): rows whose entire eligible
+  probe window is behind the frontier are retired (the ``fired_hi_tb``
+  arithmetic family — each operator supplies its own retention bound).
+
+Two shapes:
+
+- ``unique=True`` (keyed tables): one row per key, last-writer-wins by the
+  lexicographic 3-tuple meta ``(m0, m1, m2)`` — the JoinTable's
+  ``(ver, vid, vseq)`` version triplet, so a stale spill can never roll a
+  newer cold row back (the same never-roll-back rule the device table
+  enforces).
+- ``unique=False`` (interval-join archives): a multimap — every appended row
+  is retained until compaction retires it; ``fetch_multi`` returns up to R
+  rows per key *without* removing them (a row lives in exactly one tier:
+  device archive XOR device outbox XOR here — matched rows must stay
+  probeable by later arrivals).
+
+Everything is guarded by one lock (re-admission callbacks run on JAX's
+callback threads while the driver thread settles spills), and the whole
+store round-trips through :meth:`manifest`/:meth:`restore` as a dict of
+numpy arrays — it rides the existing checkpoint/exactly-once machinery as
+just more arrays, with per-array checksums for free.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: initial row capacity; grows geometrically
+_INIT_CAP = 256
+#: manifest schema version (bumped if the layout ever changes)
+_MANIFEST_VERSION = 1
+
+
+class HostStore:
+    """Growable host-memory column store keyed by int32 join keys."""
+
+    def __init__(self, name: str, cols: Dict[str, np.dtype],
+                 col_shapes: Optional[Dict[str, tuple]] = None,
+                 unique: bool = True):
+        self.name = name
+        self.unique = bool(unique)
+        self._dtypes = {k: np.dtype(v) for k, v in cols.items()}
+        self._shapes = {k: tuple(col_shapes.get(k, ()))
+                        if col_shapes else () for k in cols}
+        self._lock = threading.RLock()
+        # monotonically appended rows; holes left by compaction/overwrite
+        # are reclaimed by _rebuild when they dominate
+        self._cap = _INIT_CAP
+        self._n = 0
+        self._key = np.zeros(self._cap, np.int64)
+        self._live = np.zeros(self._cap, np.bool_)
+        self._meta = np.zeros((self._cap, 3), np.int64)   # (m0, m1, m2) LWW
+        self._cols = {k: np.zeros((self._cap,) + self._shapes[k], dt)
+                      for k, dt in self._dtypes.items()}
+        self._index: Dict[int, object] = {}   # key -> row | list[row]
+        # counters (host side of the tier telemetry)
+        self.spilled_rows = 0        # rows applied from device outboxes
+        self.readmitted_rows = 0     # rows handed back to the device tier
+        self.compacted_rows = 0      # rows retired by watermark compaction
+
+    # -- internals --------------------------------------------------------
+
+    def _grow(self, need: int) -> None:
+        while self._cap < need:
+            self._cap *= 2
+        self._key = np.resize(self._key, self._cap)
+        self._live = np.resize(self._live, self._cap)
+        self._meta = np.resize(self._meta, (self._cap, 3))
+        for k in self._cols:
+            self._cols[k] = np.resize(self._cols[k],
+                                      (self._cap,) + self._shapes[k])
+
+    def _append_row(self, key: int, meta, row: dict) -> int:
+        if self._n >= self._cap:
+            self._grow(self._n + 1)
+        i = self._n
+        self._n += 1
+        self._key[i] = key
+        self._live[i] = True
+        self._meta[i] = meta
+        for k, v in row.items():
+            self._cols[k][i] = v
+        return i
+
+    def _rebuild(self) -> None:
+        """Compact away dead rows (holes) when they dominate the storage."""
+        live_idx = np.flatnonzero(self._live[:self._n])
+        n = len(live_idx)
+        self._key[:n] = self._key[live_idx]
+        self._meta[:n] = self._meta[live_idx]
+        for k in self._cols:
+            self._cols[k][:n] = self._cols[k][live_idx]
+        self._live[:self._n] = False
+        self._live[:n] = True
+        self._n = n
+        self._reindex()
+
+    def _reindex(self) -> None:
+        self._index.clear()
+        for i in np.flatnonzero(self._live[:self._n]):
+            i = int(i)
+            k = int(self._key[i])
+            if self.unique:
+                self._index[k] = i
+            else:
+                self._index.setdefault(k, []).append(i)
+
+    # -- write side (settle point / spill application) --------------------
+
+    def upsert(self, keys, m0, m1, m2, cols: dict, ok=None) -> int:
+        """Apply spilled rows, LWW per key by ``(m0, m1, m2)`` (unique mode).
+        Returns the number of rows applied (newer-or-new)."""
+        assert self.unique, "upsert is the unique-mode write; use append"
+        keys = np.asarray(keys)
+        ok = np.ones(len(keys), bool) if ok is None else np.asarray(ok)
+        m0, m1, m2 = np.asarray(m0), np.asarray(m1), np.asarray(m2)
+        cols = {c: np.asarray(v) for c, v in cols.items()}
+        applied = 0
+        with self._lock:
+            for i in np.flatnonzero(ok):
+                i = int(i)
+                k = int(keys[i])
+                meta = (int(m0[i]), int(m1[i]), int(m2[i]))
+                row = {c: v[i] for c, v in cols.items()}
+                j = self._index.get(k)
+                if j is None:
+                    self._index[k] = self._append_row(k, meta, row)
+                    applied += 1
+                elif tuple(self._meta[j]) <= meta:
+                    self._meta[j] = meta
+                    for c, v in row.items():
+                        self._cols[c][j] = v
+                    applied += 1
+            self.spilled_rows += applied
+        return applied
+
+    def append(self, keys, m0, m1, m2, cols: dict, ok=None) -> int:
+        """Append rows unconditionally (multimap mode)."""
+        assert not self.unique, "append is the multimap write; use upsert"
+        keys = np.asarray(keys)
+        ok = np.ones(len(keys), bool) if ok is None else np.asarray(ok)
+        m0, m1, m2 = np.asarray(m0), np.asarray(m1), np.asarray(m2)
+        cols = {c: np.asarray(v) for c, v in cols.items()}
+        n = 0
+        with self._lock:
+            for i in np.flatnonzero(ok):
+                i = int(i)
+                k = int(keys[i])
+                meta = (int(m0[i]), int(m1[i]), int(m2[i]))
+                row = {c: v[i] for c, v in cols.items()}
+                self._index.setdefault(k, []).append(
+                    self._append_row(k, meta, row))
+                n += 1
+            self.spilled_rows += n
+        return n
+
+    # -- read side (re-admission callbacks) -------------------------------
+
+    def lookup(self, keys, want) -> Tuple[np.ndarray, np.ndarray, dict]:
+        """Unique-mode probe: ``(found [R] bool, meta [R, 3] int64,
+        cols {name: [R, ...]})`` — zeros where not found. Rows stay in the
+        store (supersession is by LWW spill, never by removal: a re-admitted
+        row that fails to win a device slot must remain probeable)."""
+        keys = np.asarray(keys)
+        want = np.asarray(want)
+        r = len(keys)
+        found = np.zeros(r, np.bool_)
+        meta = np.zeros((r, 3), np.int64)
+        out = {k: np.zeros((r,) + self._shapes[k], dt)
+               for k, dt in self._dtypes.items()}
+        with self._lock:
+            for i in np.flatnonzero(want):
+                i = int(i)
+                j = self._index.get(int(keys[i]))
+                if j is None:
+                    continue
+                found[i] = True
+                meta[i] = self._meta[j]
+                for k in out:
+                    out[k][i] = self._cols[k][j]
+            self.readmitted_rows += int(found.sum())
+        return found, meta, out
+
+    def fetch_multi(self, keys, want, rows_per_key: int
+                    ) -> Tuple[np.ndarray, np.ndarray, dict]:
+        """Multimap probe: up to ``rows_per_key`` rows per wanted key —
+        ``(mask [R, M] bool, meta [R, M, 3], cols {name: [R, M, ...]})``.
+        Rows are NOT removed (see the module docstring's one-tier rule);
+        truncation beyond M is deterministic (oldest rows first)."""
+        keys = np.asarray(keys)
+        want = np.asarray(want)
+        r, m = len(keys), int(rows_per_key)
+        mask = np.zeros((r, m), np.bool_)
+        meta = np.zeros((r, m, 3), np.int64)
+        out = {k: np.zeros((r, m) + self._shapes[k], dt)
+               for k, dt in self._dtypes.items()}
+        with self._lock:
+            for i in np.flatnonzero(want):
+                i = int(i)
+                rows = self._index.get(int(keys[i]))
+                if not rows:
+                    continue
+                for s, j in enumerate(rows[:m]):
+                    mask[i, s] = True
+                    meta[i, s] = self._meta[j]
+                    for k in out:
+                        out[k][i, s] = self._cols[k][j]
+            # NOT counted as re-admission: fetch is read-only (rows never
+            # change tiers — a persistent cold row served as a candidate
+            # every batch is stable residency, not movement)
+        return mask, meta, out
+
+    def pop_keys(self, max_keys: int) -> Tuple[np.ndarray, dict]:
+        """Remove and return up to ``max_keys`` keys' rows in ascending key
+        order (unique mode) — the deterministic EOS drain the tiered TopN
+        flush waves ride. Returns ``(keys [n], cols {name: [n, ...]})``."""
+        with self._lock:
+            ks = sorted(self._index)[:int(max_keys)]
+            n = len(ks)
+            keys = np.asarray(ks, np.int64)
+            out = {k: np.zeros((n,) + self._shapes[k], dt)
+                   for k, dt in self._dtypes.items()}
+            for i, k in enumerate(ks):
+                j = self._index.pop(k)
+                self._live[j] = False
+                for c in out:
+                    out[c][i] = self._cols[c][j]
+        return keys, out
+
+    # -- watermark compaction ---------------------------------------------
+
+    def compact_below(self, col: str, threshold: int) -> int:
+        """Retire every row whose ``col`` value is strictly below
+        ``threshold`` — the per-operator retention bound applied to the cold
+        tier (a retired row could never be probed/matched again). Returns
+        the number of rows retired."""
+        removed = 0
+        with self._lock:
+            if col in ("m0", "m1", "m2"):      # the LWW meta triplet (e.g.
+                #                                the JoinTable's version ts)
+                vals = self._meta[:self._n, ("m0", "m1", "m2").index(col)]
+            else:
+                vals = self._cols[col][:self._n]
+            dead = self._live[:self._n] & (
+                vals.reshape(self._n, -1).max(axis=1) < threshold
+                if vals.ndim > 1 else vals < threshold)
+            idx = np.flatnonzero(dead)
+            if len(idx):
+                self._live[idx] = False
+                removed = len(idx)
+                self._reindex()
+                if self._live[:self._n].sum() * 2 < self._n:
+                    self._rebuild()
+            self.compacted_rows += removed
+        return removed
+
+    # -- introspection / durability ---------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index) if self.unique else \
+                int(self._live[:self._n].sum())
+
+    def key_count(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"state_spills": self.spilled_rows,
+                    "state_readmits": self.readmitted_rows,
+                    "state_compactions": self.compacted_rows}
+
+    def manifest(self) -> Dict[str, np.ndarray]:
+        """Checkpointable snapshot: dense copies of the live rows + the
+        counters — plain numpy arrays, so the checkpoint layer's per-array
+        sha256 and atomic-write machinery cover the cold tier unchanged."""
+        with self._lock:
+            live_idx = np.flatnonzero(self._live[:self._n])
+            out = {"key": self._key[live_idx].copy(),
+                   "meta": self._meta[live_idx].copy(),
+                   "counters": np.asarray(
+                       [_MANIFEST_VERSION, self.spilled_rows,
+                        self.readmitted_rows, self.compacted_rows],
+                       np.int64)}
+            for k in self._cols:
+                out[f"col_{k}"] = self._cols[k][live_idx].copy()
+            return out
+
+    def restore(self, manifest: Dict[str, np.ndarray]) -> None:
+        """Replace the store content with a :meth:`manifest` snapshot (the
+        supervised-restore path: in-flight spills were discarded by the
+        controller; replay re-derives them)."""
+        with self._lock:
+            keys = np.asarray(manifest["key"])
+            n = len(keys)
+            self._cap = max(_INIT_CAP, 1 << max(1, (n - 1).bit_length()))
+            self._n = n
+            self._key = np.zeros(self._cap, np.int64)
+            self._key[:n] = keys
+            self._live = np.zeros(self._cap, np.bool_)
+            self._live[:n] = True
+            self._meta = np.zeros((self._cap, 3), np.int64)
+            self._meta[:n] = np.asarray(manifest["meta"]).reshape(n, 3)
+            self._cols = {k: np.zeros((self._cap,) + self._shapes[k], dt)
+                          for k, dt in self._dtypes.items()}
+            for k in self._cols:
+                self._cols[k][:n] = np.asarray(manifest[f"col_{k}"])
+            ctr = np.asarray(manifest.get("counters",
+                                          np.zeros(4, np.int64)))
+            self.spilled_rows = int(ctr[1]) if len(ctr) > 1 else 0
+            self.readmitted_rows = int(ctr[2]) if len(ctr) > 2 else 0
+            self.compacted_rows = int(ctr[3]) if len(ctr) > 3 else 0
+            self._reindex()
