@@ -278,6 +278,62 @@ def _health_compile_stats(steps: int = 8, batch: int = 4096) -> dict:
             "compiles_per_step": round(led.traces / max(n, 1), 4)}
 
 
+def _shard_recovery_stats(shards: int = 4, total_batches: int = 24,
+                          batch: int = 4096) -> dict:
+    """Hermetic shard-local-recovery numbers for the trend (device-free,
+    the ``cost``/``health`` convention): drive a small YSB chain through
+    the SHARDED supervisor with one injected ``shard.kill``, and report the
+    killed shard's measured restore+replay duration (``last_recovery_s``
+    off the shard report) plus the byte-identity verdict vs an unsharded
+    run — the per-shard-recovery-time column ``bench_trend.py`` renders,
+    moving even in tunnel-down rounds."""
+    import numpy as np
+    from windflow_tpu.benchmarks import ysb
+    from windflow_tpu.operators.sink import Sink
+    from windflow_tpu.runtime.faults import (FaultInjector, FaultPlan,
+                                             FaultSpec)
+    from windflow_tpu.runtime.supervisor import SupervisedPipeline
+
+    panes_per_batch = max(batch // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN), 1) + 1
+
+    def run(n_shards, faults=None):
+        got = []
+
+        def cb(view):
+            if view is None:
+                return
+            got.extend(zip(view["key"].tolist(), view["id"].tolist()))
+        src = ysb.make_source(total=total_batches * batch)
+        ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
+                           max_wins=panes_per_batch + 64)
+        p = SupervisedPipeline(src, ops, Sink(cb), batch_size=batch,
+                               checkpoint_every=4, max_restarts=4,
+                               backoff_base=0.0, shards=n_shards,
+                               # hermetic drill: a caller's WF_RESHARD must
+                               # not leak a live reshard into the recovery
+                               # timing (the perfgate event_time=False rule)
+                               reshard=False,
+                               # ownership follows the WINDOW key (the
+                               # ysb_rekey campaign), not the ingest key
+                               shard_key=lambda t:
+                                   t.ad_id // ysb.ADS_PER_CAMPAIGN,
+                               faults=faults)
+        p.run()
+        return sorted(got), p
+
+    oracle, _ = run(1)
+    kill = FaultInjector(FaultPlan(
+        [FaultSpec("shard.kill", where={"shard": shards // 2},
+                   max_fires=1)], seed=7))
+    sharded, p = run(shards, faults=kill)
+    rep = p.shard_report()
+    killed = rep[shards // 2]
+    return {"shards": int(shards),
+            "recovery_ms": round(killed["last_recovery_s"] * 1e3, 3),
+            "killed_restarts": killed["restarts"],
+            "kill_exact": sharded == oracle}
+
+
 def bench_ysb():
     import jax
     import jax.numpy as jnp
@@ -1261,6 +1317,14 @@ def main():
     except Exception as e:  # noqa: BLE001 — a trend column must never
         #                     block the headline
         print(f"health compile stats unavailable: {e}", file=sys.stderr)
+    try:
+        # shard-local recovery column (device-free, like `health`): a
+        # kill-one-shard drill through the sharded supervisor — recovery
+        # duration + the byte-identity verdict ride every capture
+        headline["shard"] = _shard_recovery_stats()
+    except Exception as e:  # noqa: BLE001 — a trend column must never
+        #                     block the headline
+        print(f"shard recovery stats unavailable: {e}", file=sys.stderr)
     record_headline(headline)
     try:
         _secondary_benches(ysb_tps, ysb_step_s, headline)

@@ -72,7 +72,8 @@ def bench_rows(rounds, threshold: float):
         row = {"round": n, "rc": rc, "value": None, "unit": "",
                "vs_baseline": None, "stale": False, "status": "",
                "note": "", "flops_per_step": None, "bytes_per_step": None,
-               "launches_per_step": None, "compiles_per_step": None}
+               "launches_per_step": None, "compiles_per_step": None,
+               "shard_recovery_ms": None}
         if parsed is None or rc not in (0, None):
             # rc=1/parsed=null rounds MUST surface — a silent skip would
             # render the failed round as "nothing happened"
@@ -86,6 +87,7 @@ def bench_rows(rounds, threshold: float):
         cost = parsed.get("cost") or {}
         dispatch = parsed.get("dispatch") or {}
         health = parsed.get("health") or {}
+        shard = parsed.get("shard") or {}
         row.update(value=value, unit=parsed.get("unit", ""),
                    vs_baseline=parsed.get("vs_baseline"),
                    stale=bool(parsed.get("stale")),
@@ -103,7 +105,14 @@ def bench_rows(rounds, threshold: float):
                    # hermetic device_health ledger): jit traces per driven
                    # step through CompiledChain.push — trace stability
                    # moves every round, tunnel up or down
-                   compiles_per_step=health.get("compiles_per_step"))
+                   compiles_per_step=health.get("compiles_per_step"),
+                   # shard-local recovery (bench.py headline `shard`): the
+                   # killed shard's measured restore+replay duration — the
+                   # per-shard-recovery-time trend, moving in tunnel-down
+                   # rounds like the other hermetic columns (only honest
+                   # drills count: a kill that diverged renders "—")
+                   shard_recovery_ms=(shard.get("recovery_ms")
+                                      if shard.get("kill_exact") else None))
         if value is None:
             row["status"] = "FAILED"
             row["note"] = "parsed record without a value"
@@ -251,8 +260,8 @@ def render_markdown(bench, multichip, threshold: float,
     lines.append("")
     lines.append("| round | status | value | unit | vs baseline "
                  "| Mflop/step | MB/step | launches/step | compiles/step "
-                 "| note |")
-    lines.append("|---|---|---|---|---|---|---|---|---|---|")
+                 "| shard recov ms | note |")
+    lines.append("|---|---|---|---|---|---|---|---|---|---|---|")
     for r in bench:
         mflop = (f"{r['flops_per_step'] / 1e6:.2f}"
                  if r.get("flops_per_step") else "—")
@@ -262,13 +271,15 @@ def render_markdown(bench, multichip, threshold: float,
                if r.get("launches_per_step") else "—")
         cps = (f"{r['compiles_per_step']:g}"
                if r.get("compiles_per_step") else "—")
+        srm = (f"{r['shard_recovery_ms']:g}"
+               if r.get("shard_recovery_ms") is not None else "—")
         lines.append(f"| r{r['round']:02d} | {r['status']} "
                      f"| {_fmt(r['value'])} | {r['unit'] or '—'} "
                      f"| {_fmt(r['vs_baseline'])} "
-                     f"| {mflop} | {mb} | {lps} | {cps} "
+                     f"| {mflop} | {mb} | {lps} | {cps} | {srm} "
                      f"| {_cell(r['note'] or '')} |")
     if not bench:
-        lines.append("| — | — | — | — | — | — | — | — | — "
+        lines.append("| — | — | — | — | — | — | — | — | — | — "
                      "| no BENCH_r*.json found |")
     if nexmark is not None:
         lines += render_nexmark(*nexmark)

@@ -21,9 +21,18 @@ byte-identity claim and the recovery machinery in one sweep. The graph_det
 driver (DETERMINISTIC merge) keeps the Ordering_Node's async counts
 readback in every sweep, dispatch or not.
 
+--shards N runs the two SUPERVISED drivers (pipeline + graph) through the
+shard-local supervision layer (N ShardSupervisor units) and widens each
+seed's plan with shard-kill and torn reshard-handoff injection; the
+fault-free baselines stay UNSHARDED, so every seed asserts shard-count
+invariance AND shard-local recovery byte-identity at once. The sharded
+pipeline run additionally carries a mid-stream N -> 2N live reshard.
+(--shards excludes --dispatch on the supervised drivers: WF115.)
+
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --total 400
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --controller
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --dispatch 4
+    JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --shards 4
 """
 
 import argparse
@@ -71,7 +80,8 @@ def collect(acc):
     return cb
 
 
-def run_pipeline(total, batch, faults=None, controller=False, dispatch=False):
+def run_pipeline(total, batch, faults=None, controller=False, dispatch=False,
+                 shards=0):
     got = []
     src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
                     total=total, num_keys=4)
@@ -81,13 +91,20 @@ def run_pipeline(total, batch, faults=None, controller=False, dispatch=False):
                        checkpoint_every=3, max_restarts=8,
                        backoff_base=0.001, backoff_cap=0.01,
                        faults=faults, dispatch=dispatch,
+                       shards=shards or 1,
+                       # sharded runs also cross a live N -> 2N reshard at
+                       # the first barrier past 1/3 of the stream — chaos
+                       # seeds then hit shard kills AND torn handoffs
+                       reshard=({"new_shards": shards * 2,
+                                 "at_pos": max(1, total // batch // 3)}
+                                if shards else False),
                        control=sup_control(batch) if controller else False
                        ).run()
     return sorted(got)
 
 
 def run_graph(total, batch, faults=None, controller=False, dispatch=False,
-              mode=None):
+              mode=None, shards=0):
     from windflow_tpu.basic import Mode
     got = []
     g = PipeGraph("sweep", batch_size=batch,
@@ -102,18 +119,24 @@ def run_graph(total, batch, faults=None, controller=False, dispatch=False,
      .add_sink(wf.Sink(collect(got))))
     g.run_supervised(checkpoint_every=3, max_restarts=8,
                      backoff_base=0.001, backoff_cap=0.01, faults=faults,
+                     shards=shards or 1,
+                     # hermetic: the graph runs never reshard in the sweep —
+                     # a caller's WF_RESHARD must not diverge them from the
+                     # unsharded baselines (run_pipeline pins its own plan)
+                     reshard=False,
                      control=sup_control(batch) if controller else False)
     return sorted(got)
 
 
 def run_graph_det(total, batch, faults=None, controller=False,
-                  dispatch=False):
+                  dispatch=False, shards=0):
     # DETERMINISTIC merge: every root push drives the Ordering_Node's
     # async [n_released, n_kept] readback — the sync-free hot path under
     # chaos (and under fused dispatch when --dispatch is on)
     from windflow_tpu.basic import Mode
     return run_graph(total, batch, faults=faults, controller=controller,
-                     dispatch=dispatch, mode=Mode.DETERMINISTIC)
+                     dispatch=dispatch, mode=Mode.DETERMINISTIC,
+                     shards=shards)
 
 
 def run_threaded(total, batch, faults=None, controller=False,
@@ -132,15 +155,24 @@ def run_threaded(total, batch, faults=None, controller=False,
     return sorted(got)
 
 
-def plan_for(seed, threaded=False):
+def plan_for(seed, threaded=False, shards=0):
     if threaded:
         # the threaded driver has no replay machinery: stalls only (delay,
         # never drop) — the watchdog must notice, results must not change
         return FaultPlan([FaultSpec("queue.stall", kind="stall", p=0.15,
                                     stall_s=0.4)], seed=seed)
-    return FaultPlan([FaultSpec("source.next", p=0.06),
-                      FaultSpec("chain.step", p=0.08),
-                      FaultSpec("sink.consume", p=0.10)], seed=seed)
+    specs = [FaultSpec("source.next", p=0.06),
+             FaultSpec("chain.step", p=0.08),
+             FaultSpec("sink.consume", p=0.10)]
+    if shards:
+        # shard-local drills: random shard step kills (each recovers by
+        # replaying ONLY that shard's key range) + a torn handoff against
+        # the mid-stream live reshard (the seal must be discarded and the
+        # move re-derived at the same barrier)
+        specs += [FaultSpec("shard.kill", p=0.05),
+                  FaultSpec("reshard.handoff", kind="torn", p=0.25,
+                            max_fires=1)]
+    return FaultPlan(specs, seed=seed)
 
 
 def main():
@@ -157,8 +189,22 @@ def main():
                     "push_many) while the baselines stay per-batch — the "
                     "fused path must match the per-batch fault-free oracle "
                     "byte-for-byte")
+    ap.add_argument("--shards", type=int, default=0, metavar="N",
+                    help="run the supervised drivers (pipeline + graph) "
+                    "through N-way shard-local supervision (plus a live "
+                    "N->2N reshard on the pipeline driver) with shard-kill "
+                    "and torn-handoff injection added to each seed's plan; "
+                    "baselines stay unsharded, so every seed asserts "
+                    "shard-count invariance and shard-local recovery at "
+                    "once")
     args = ap.parse_args()
+    if args.shards and args.dispatch:
+        ap.error("--shards excludes --dispatch on the supervised drivers "
+                 "(WF115: a fused group failure has no single shard's "
+                 "replay extent)")
 
+    #: drivers that route through the sharded supervisors under --shards
+    sharded_drivers = {"pipeline", "graph", "graph_det"}
     drivers = {"pipeline": run_pipeline, "graph": run_graph,
                "graph_det": run_graph_det, "threaded": run_threaded}
     baselines = {}
@@ -172,12 +218,16 @@ def main():
     divergences = 0
     for seed in range(args.seeds):
         for name, fn in drivers.items():
-            inj = FaultInjector(plan_for(seed, threaded=(name == "threaded")))
+            n_shards = args.shards if name in sharded_drivers else 0
+            inj = FaultInjector(plan_for(seed, threaded=(name == "threaded"),
+                                         shards=n_shards))
             t0 = time.time()
             try:
+                kw = {"shards": n_shards} if n_shards else {}
                 out = fn(args.total, args.batch, faults=inj,
                          controller=args.controller,
-                         dispatch=args.dispatch)   # 0 = off (every driver)
+                         dispatch=args.dispatch,   # 0 = off (every driver)
+                         **kw)
             except Exception as e:          # noqa: BLE001
                 print(f"[seed {seed}] {name}: RUN FAILED {type(e).__name__}: "
                       f"{e} ({len(inj.fired)} faults injected)")

@@ -237,6 +237,53 @@ def device_time_report(snap):
     return lines
 
 
+def shard_report(snap, journal):
+    """Per-shard supervision health: one row per shard (host-tagged in a
+    fleet merge — the keys name WHICH shard is hot), occupancy + restart
+    counts + last recovery duration + reshard movements, plus the journal's
+    shard_restore/reshard timeline tail."""
+    lines = ["shard supervision"]
+    shards = snap.get("shards") or {}
+    if not shards:
+        lines.append("  (no shards section — run the supervised driver "
+                     "with shards=N / WF_SHARDS=N and monitoring on)")
+        return lines
+    hot = max(shards, key=lambda k: shards[k].get("occupancy_tuples", 0))
+    lines.append(f"  {len(shards)} shard(s); hottest: {hot} "
+                 f"({shards[hot].get('occupancy_tuples', 0)} tuples)")
+    lines.append(f"  {'shard':>12} {'tuples':>10} {'restarts':>8} "
+                 f"{'recov_ms':>9} {'dead':>5} {'moves':>6} {'pos':>6}")
+    for k in sorted(shards, key=lambda x: (len(x), x)):
+        r = shards[k]
+        flag = "  [HOT]" if k == hot and len(shards) > 1 else ""
+        lines.append(
+            f"  {k:>12} {r.get('occupancy_tuples', 0):>10} "
+            f"{r.get('restarts', 0):>8} "
+            f"{r.get('last_recovery_s', 0.0) * 1e3:>9.2f} "
+            f"{r.get('dead_letters', 0):>5} {r.get('reshard_moves', 0):>6} "
+            f"{r.get('committed_pos', 0):>6}{flag}")
+    # reshard spans emit begin+end records — keep one line per reshard
+    # (the wf_state.py shard_section convention)
+    ev = [e for e in journal
+          if e.get("event") in ("shard_restore", "reshard")
+          and e.get("phase") != "end"]
+    if ev:
+        lines.append(f"  recovery/reshard events: {len(ev)} "
+                     f"(last {min(5, len(ev))}):")
+        for e in ev[-5:]:
+            if e.get("event") == "shard_restore":
+                lines.append(f"    shard_restore shard={e.get('shard')} "
+                             f"at={e.get('at_batch')} "
+                             f"replay_from={e.get('replay_from')} "
+                             f"error={e.get('error')}")
+            else:
+                lines.append(f"    reshard {e.get('from_shards')}->"
+                             f"{e.get('to_shards')} at={e.get('at_pos')} "
+                             f"moves={e.get('moves')}"
+                             + (" DISCARDED" if e.get("discarded") else ""))
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="wf_health",
@@ -251,7 +298,8 @@ def main(argv=None) -> int:
                          "snapshots.jsonl paths) into one fleet view "
                          "instead of reading --monitoring-dir")
     ap.add_argument("--report", choices=("all", "memory", "compile",
-                                         "device-time"), default="all",
+                                         "device-time", "shards"),
+                    default="all",
                     help="which section(s) to render (default all)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output: the (merged) snapshot's "
@@ -285,6 +333,7 @@ def main(argv=None) -> int:
     if args.json:
         out = {"graph": snap.get("graph"),
                "health": snap.get("health") or {},
+               "shards": snap.get("shards") or {},
                "snapshots": len(series),
                "journal_events": len(journal)}
         if snap.get("hosts"):
@@ -305,6 +354,9 @@ def main(argv=None) -> int:
         blocks.append(compile_report(snap, journal))
     if args.report in ("all", "device-time"):
         blocks.append(device_time_report(snap))
+    if args.report == "shards" or (args.report == "all"
+                                   and snap.get("shards")):
+        blocks.append(shard_report(snap, journal))
     for b in blocks:
         print()
         print("\n".join(b))

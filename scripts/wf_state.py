@@ -253,6 +253,36 @@ def lateness_report(snap, journal, et, q):
     return lines, data
 
 
+def shard_section(snap, journal):
+    """Per-shard supervision rows (the ``shards`` snapshot section written
+    by the sharded supervisors; host-tagged keys in a fleet merge so the
+    view names WHICH shard is hot) + the shard_restore/reshard timeline."""
+    lines = ["== shard supervision =="]
+    shards = snap.get("shards") or {}
+    if not shards:
+        lines.append("  (no shards section — run the supervised driver "
+                     "with shards=N / WF_SHARDS=N and monitoring on)")
+        return lines
+    hot = max(shards, key=lambda k: shards[k].get("occupancy_tuples", 0))
+    for k in sorted(shards, key=lambda x: (len(x), x)):
+        r = shards[k]
+        flag = "  [HOT]" if k == hot and len(shards) > 1 else ""
+        lines.append(
+            f"  shard {k:<12} tuples={r.get('occupancy_tuples', 0):<8} "
+            f"restarts={r.get('restarts', 0)} "
+            f"last_recovery={r.get('last_recovery_s', 0.0) * 1e3:.2f}ms "
+            f"dead_letters={r.get('dead_letters', 0)} "
+            f"reshard_moves={r.get('reshard_moves', 0)} "
+            f"committed_pos={r.get('committed_pos', 0)}{flag}")
+    n_rest = sum(1 for e in journal if e.get("event") == "shard_restore")
+    n_rs = sum(1 for e in journal if e.get("event") == "reshard"
+               and e.get("phase") != "end")
+    if n_rest or n_rs:
+        lines.append(f"  journal: {n_rest} shard_restore event(s), "
+                     f"{n_rs} reshard event(s)")
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="wf_state",
@@ -274,7 +304,8 @@ def main(argv=None) -> int:
                     help=f"occupancy percentage flagged [OVERFLOW-RISK] in "
                          f"the pressure/tier reports (default {RISK_PCT})")
     ap.add_argument("--report", choices=("all", "watermarks", "pressure",
-                                         "tier", "lateness"), default="all",
+                                         "tier", "lateness", "shards"),
+                    default="all",
                     help="which section(s) to render (default all)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output: the latest snapshot's "
@@ -328,6 +359,7 @@ def main(argv=None) -> int:
                "risk_threshold": args.risk_threshold,
                "tier": {name: sec["tier"] for name, sec in _et_rows(snap)
                         if isinstance(sec.get("tier"), dict)},
+               "shards": snap.get("shards") or {},
                "snapshots": len(series)}
         if snap.get("hosts"):
             out["hosts"] = snap["hosts"]
@@ -343,6 +375,9 @@ def main(argv=None) -> int:
         blocks.append(tier_report(snap, series, args.risk_threshold))
     if args.report in ("all", "lateness"):
         blocks.append(lat_lines)
+    if args.report == "shards" or (args.report == "all"
+                                   and snap.get("shards")):
+        blocks.append(shard_section(snap, journal))
     head = (f"wf_state: merged {snap.get('merged_from')} host(s): "
             + ", ".join(h.get("host", "?") for h in snap.get("hosts", []))
             if args.merge else f"wf_state: {args.monitoring_dir!r}")

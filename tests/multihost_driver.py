@@ -3,10 +3,24 @@
 Run as: ``python tests/multihost_driver.py <coordinator> <num_procs> <proc_id>``
 from the repo root (cwd provides the windflow_tpu import — PYTHONPATH must stay
 unset in this environment). Each process gets 4 virtual CPU devices; together
-they form the DCN×ICI mesh (key axis across processes, dp axis inside) and run
-``keyed_all_to_all`` across the process boundary.
+they form the DCN×ICI mesh (key axis across processes, dp axis inside).
 
-Prints ``MULTIHOST-OK <n_received>`` on success.
+Two parts, in order:
+
+1. **Shard-local supervision across the process boundary** (always runs):
+   each process supervises ITS slice of a 4-shard ``ShardedSupervisor``
+   layout over the same logical stream — per-shard recovery domains with a
+   shard-kill drill, NO cross-process collectives (that is the point of
+   shard-local recovery), so this is a real multi-process code path even on
+   platforms whose CPU backend cannot run cross-process computations.
+   Prints ``SHARD-OK <n_results> <digest> range=<lo>:<hi> restarts=<n>``.
+
+2. **keyed_all_to_all over DCN** (platform-dependent): the collective
+   exchange across the process boundary. On jaxlib builds where
+   multiprocess CPU computations are unimplemented this prints
+   ``COLLECTIVES-UNSUPPORTED <reason>`` and exits 0 — part 1 already
+   exercised the multi-process path, so the test no longer skips.
+   Prints ``MULTIHOST-OK <n_received>`` / ``LOSSLESS-OK ...`` when it runs.
 """
 
 import os
@@ -40,6 +54,68 @@ import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
+# ---- part 1: shard-local supervision across the process boundary ---------
+# This process supervises shards [lo, hi) of the 4-shard layout over the
+# SAME logical stream as its peer — per-shard restart budgets, outboxes,
+# and a shard-kill drill on the first local shard, all without a single
+# cross-process collective (the shard-local recovery contract). The parent
+# test unions both processes' result multisets against an unsharded oracle.
+import windflow_tpu as wf  # noqa: E402
+from windflow_tpu.basic import win_type_t  # noqa: E402
+from windflow_tpu.operators.window import WindowSpec  # noqa: E402
+from windflow_tpu.runtime.faults import (FaultInjector, FaultPlan,  # noqa: E402
+                                         FaultSpec)
+from windflow_tpu.runtime.supervisor import SupervisedPipeline  # noqa: E402
+
+SH_TOTAL, SH_KEYS, SH_SHARDS = 240, 8, 4
+lo, hi = multihost.process_shard_slice(SH_SHARDS)
+assert hi - lo == SH_SHARDS // num_procs, (lo, hi)
+
+got = []
+
+
+def _collect(view):
+    if view is None:
+        return
+    got.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                   np.asarray(view["payload"]).tolist()))
+
+
+kill = FaultInjector(FaultPlan(
+    [FaultSpec("shard.kill", where={"shard": lo}, max_fires=1)], seed=11))
+sp = SupervisedPipeline(
+    wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+              total=SH_TOTAL, num_keys=SH_KEYS),
+    [wf.Win_Seq(lambda wid, it: it.sum("v"),
+                WindowSpec(10, 10, win_type_t.TB), num_keys=SH_KEYS)],
+    wf.Sink(_collect), batch_size=30, checkpoint_every=2, max_restarts=4,
+    backoff_base=0.0, shards=SH_SHARDS, shard_range=(lo, hi), faults=kill)
+sp.run()
+rep = sp.shard_report()
+assert rep[lo]["restarts"] == 1, rep          # the drill recovered locally
+assert all(r["restarts"] == 0 for k, r in rep.items() if k != lo), rep
+digest = sum((k + 1) * 1_000_003 + (i + 1) * 31 + int(v * 7)
+             for k, i, v in got) % (1 << 31)
+print(f"SHARD-OK {len(got)} {digest} range={lo}:{hi} "
+      f"restarts={rep[lo]['restarts']}")
+
+# ---- part 2: collectives over DCN (platform-dependent) -------------------
+#: stderr/exception signatures of a CPU backend that cannot run
+#: cross-process computations at all — part 2 then reports unsupported and
+#: exits 0 (part 1 already proved the multi-process path)
+_COLLECTIVE_UNSUPPORTED = (
+    # the ONE precise jaxlib signature — a broad "not implemented" match
+    # would let a genuine collectives regression masquerade as a platform
+    # gap (the PR 10 quarantine-hardening lesson)
+    "Multiprocess computations aren't implemented",
+)
+
+
+def _unsupported(e) -> bool:
+    msg = str(e)
+    return any(sig.lower() in msg.lower() for sig in _COLLECTIVE_UNSUPPORTED)
+
+
 from windflow_tpu.parallel.collective import keyed_all_to_all  # noqa: E402
 
 # key axis spans the two hosts over DCN (documented-legal: the keyed exchange
@@ -52,75 +128,88 @@ for krow in range(num_procs):
     procs = {d.process_index for d in mesh.devices[krow].flat}
     assert len(procs) == 1, f"DCN row {krow} spans processes {procs}"
 
-C = 64                                   # global rows, sharded over the key axis
-exchange = keyed_all_to_all(mesh, axis="key", capacity=C)
+def _collectives():
+    C = 64                                   # global rows, sharded over the key axis
+    exchange = keyed_all_to_all(mesh, axis="key", capacity=C)
 
-gen = jax.jit(lambda: (jnp.arange(C, dtype=jnp.int32) * 7 % 13,
-                       jnp.ones((C,), jnp.bool_),
-                       {"v": jnp.arange(C, dtype=jnp.float32)}),
-              out_shardings=(NamedSharding(mesh, P("key")),
-                             NamedSharding(mesh, P("key")),
-                             NamedSharding(mesh, P("key"))))
-keys, valid, payload = gen()
-out_keys, out_valid, out_pay, n_left = exchange(keys, valid, payload)
-# capacity C: complete exchange (n_left is global — read this process's shards)
-assert all(int(np.asarray(s.data).sum()) == 0
-           for s in n_left.addressable_shards)
+    gen = jax.jit(lambda: (jnp.arange(C, dtype=jnp.int32) * 7 % 13,
+                           jnp.ones((C,), jnp.bool_),
+                           {"v": jnp.arange(C, dtype=jnp.float32)}),
+                  out_shardings=(NamedSharding(mesh, P("key")),
+                                 NamedSharding(mesh, P("key")),
+                                 NamedSharding(mesh, P("key"))))
+    keys, valid, payload = gen()
+    out_keys, out_valid, out_pay, n_left = exchange(keys, valid, payload)
+    # capacity C: complete exchange (n_left is global — read this process's shards)
+    assert all(int(np.asarray(s.data).sum()) == 0
+               for s in n_left.addressable_shards)
 
-# every row landed on the key-axis shard that owns its key (owner = key % 2),
-# with its payload riding along
-n_local = 0
-for shard_k, shard_v, shard_p in zip(out_keys.addressable_shards,
-                                     out_valid.addressable_shards,
-                                     out_pay["v"].addressable_shards):
-    coord = np.argwhere(mesh.devices == shard_k.device)
-    assert coord.shape == (1, 2), coord
-    key_coord = int(coord[0][0])
-    kv = np.asarray(shard_k.data)
-    vv = np.asarray(shard_v.data)
-    pv = np.asarray(shard_p.data)
-    assert np.all(kv[vv] % num_procs == key_coord), (key_coord, kv[vv])
-    assert np.all(pv[vv] * 7 % 13 == kv[vv])       # payload stayed with its key
-    n_local += int(vv.sum())
+    # every row landed on the key-axis shard that owns its key (owner = key % 2),
+    # with its payload riding along
+    n_local = 0
+    for shard_k, shard_v, shard_p in zip(out_keys.addressable_shards,
+                                         out_valid.addressable_shards,
+                                         out_pay["v"].addressable_shards):
+        coord = np.argwhere(mesh.devices == shard_k.device)
+        assert coord.shape == (1, 2), coord
+        key_coord = int(coord[0][0])
+        kv = np.asarray(shard_k.data)
+        vv = np.asarray(shard_v.data)
+        pv = np.asarray(shard_p.data)
+        assert np.all(kv[vv] % num_procs == key_coord), (key_coord, kv[vv])
+        assert np.all(pv[vv] * 7 % 13 == kv[vv])       # payload stayed with its key
+        n_local += int(vv.sum())
 
-# no row lost in the exchange: global count over both processes == C
-from jax.experimental import multihost_utils  # noqa: E402
-total = int(multihost_utils.process_allgather(jnp.asarray(n_local)).sum())
-# every dp member holds a replicated copy of its host's received rows
-assert total == C * 4, (total, C * 4)
+    # no row lost in the exchange: global count over both processes == C
+    from jax.experimental import multihost_utils  # noqa: E402
+    total = int(multihost_utils.process_allgather(jnp.asarray(n_local)).sum())
+    # every dp member holds a replicated copy of its host's received rows
+    assert total == C * 4, (total, C * 4)
 
-print(f"MULTIHOST-OK {n_local}")
+    print(f"MULTIHOST-OK {n_local}")
 
-# -- lossless variant across the same process boundary --------------------------
-# Skewed keys: every row targets owner 1 while the per-(src,dst) lane budget is
-# capacity=2, so each source can ship only 2 of its 8 rows per round and the
-# exchange MUST take multiple rounds — the blocking-bounded-queue semantics
-# (r05: overflow is lossless or loud, never silent) over a real DCN boundary.
-from windflow_tpu.parallel.collective import keyed_all_to_all_lossless  # noqa: E402
+    # -- lossless variant across the same process boundary --------------------------
+    # Skewed keys: every row targets owner 1 while the per-(src,dst) lane budget is
+    # capacity=2, so each source can ship only 2 of its 8 rows per round and the
+    # exchange MUST take multiple rounds — the blocking-bounded-queue semantics
+    # (r05: overflow is lossless or loud, never silent) over a real DCN boundary.
+    from windflow_tpu.parallel.collective import keyed_all_to_all_lossless  # noqa: E402
 
-SMALL = 16
-lossless = keyed_all_to_all_lossless(mesh, axis="key", capacity=2)
-gen2 = jax.jit(lambda: (jnp.full((SMALL,), 1, jnp.int32),
-                        jnp.ones((SMALL,), jnp.bool_),
-                        {"v": jnp.arange(SMALL, dtype=jnp.float32)}),
-               out_shardings=(NamedSharding(mesh, P("key")),
-                              NamedSharding(mesh, P("key")),
-                              NamedSharding(mesh, P("key"))))
-k2, v2, p2 = gen2()
-lk, lv, lp, n_rounds = lossless(k2, v2, p2)
-assert n_rounds > 1, f"skew did not overflow (rounds={n_rounds})"
-# The multi-round concatenation may leave the output partially replicated
-# (documented in keyed_all_to_all_lossless), so per-shard layout asserts are
-# invalid here; validate with LOGICAL global reductions instead — replicated
-# results, identical on both processes, independent of XLA's layout choice.
-chk = jax.jit(lambda k, v, p: (
-    jnp.sum(v.astype(jnp.int32)),                  # rows delivered (once each)
-    jnp.sum(jnp.where(v, p["v"], 0.0)),            # payload sum rides along
-    jnp.all(jnp.where(v, k == 1, True))))          # every live row has key 1
-n_delivered, v_sum, keys_ok = (int(x) if x.ndim == 0 else x
-                               for x in map(np.asarray, chk(lk, lv, lp)))
-assert n_delivered == SMALL, (n_delivered, SMALL)
-assert v_sum == sum(range(SMALL)), v_sum
-assert keys_ok
+    SMALL = 16
+    lossless = keyed_all_to_all_lossless(mesh, axis="key", capacity=2)
+    gen2 = jax.jit(lambda: (jnp.full((SMALL,), 1, jnp.int32),
+                            jnp.ones((SMALL,), jnp.bool_),
+                            {"v": jnp.arange(SMALL, dtype=jnp.float32)}),
+                   out_shardings=(NamedSharding(mesh, P("key")),
+                                  NamedSharding(mesh, P("key")),
+                                  NamedSharding(mesh, P("key"))))
+    k2, v2, p2 = gen2()
+    lk, lv, lp, n_rounds = lossless(k2, v2, p2)
+    assert n_rounds > 1, f"skew did not overflow (rounds={n_rounds})"
+    # The multi-round concatenation may leave the output partially replicated
+    # (documented in keyed_all_to_all_lossless), so per-shard layout asserts are
+    # invalid here; validate with LOGICAL global reductions instead — replicated
+    # results, identical on both processes, independent of XLA's layout choice.
+    chk = jax.jit(lambda k, v, p: (
+        jnp.sum(v.astype(jnp.int32)),                  # rows delivered (once each)
+        jnp.sum(jnp.where(v, p["v"], 0.0)),            # payload sum rides along
+        jnp.all(jnp.where(v, k == 1, True))))          # every live row has key 1
+    n_delivered, v_sum, keys_ok = (int(x) if x.ndim == 0 else x
+                                   for x in map(np.asarray, chk(lk, lv, lp)))
+    assert n_delivered == SMALL, (n_delivered, SMALL)
+    assert v_sum == sum(range(SMALL)), v_sum
+    assert keys_ok
 
-print(f"LOSSLESS-OK {n_delivered} rounds={n_rounds}")
+    print(f"LOSSLESS-OK {n_delivered} rounds={n_rounds}")
+
+
+try:
+    _collectives()
+except SystemExit:
+    raise
+except Exception as e:  # noqa: BLE001 — platform capability probe
+    if _unsupported(e):
+        line = str(e).splitlines()[0][:160]
+        print(f"COLLECTIVES-UNSUPPORTED {line}")
+        sys.exit(0)
+    raise

@@ -216,6 +216,34 @@ def chain_step_cost(name: str) -> Dict[str, float]:
     return out
 
 
+#: reshard_pack pin geometry: one batch split into N masked per-shard
+#: sub-batches (``parallel/sharding.py::ShardAssignment.split_fn`` — the
+#: only per-batch program the sharded supervisors add, and the pack step of
+#: the re-sharding handoff)
+RESHARD_PACK_CAPACITY = 2048
+RESHARD_PACK_SHARDS = 4
+
+
+def reshard_pack_cost() -> Dict[str, float]:
+    """AOT cost of the shard splitter at the pinned geometry — zero
+    execution, CPU backend. The pin guards the claim that sharding's
+    per-batch overhead is ONE masked split (a change that sneaks a gather,
+    sort, or device round trip into the splitter moves this number)."""
+    import jax
+    import jax.numpy as jnp
+    from ..batch import Batch
+    from ..parallel.sharding import ShardAssignment
+    cap = RESHARD_PACK_CAPACITY
+    assign = ShardAssignment(RESHARD_PACK_SHARDS)
+    bspec = jax.eval_shape(
+        lambda: Batch.empty(cap, {"v": jnp.zeros((), jnp.float32)}))
+    compiled = assign.split_fn().lower(bspec).compile()
+    out = _cost_of(compiled)
+    out["capacity"] = cap
+    out["shards"] = RESHARD_PACK_SHARDS
+    return out
+
+
 def workload_scan_cost(name: str) -> Dict[str, float]:
     """AOT cost of the K-fused scan-dispatch program for one
     ``SCAN_WORKLOADS`` entry: ``CompiledChain._scan_fn`` (the ``lax.scan``
@@ -390,6 +418,18 @@ def proxy_microbench(reps: int = 3) -> Dict[str, dict]:
     row.update(dispatch_launch_counts(k=KD, capacity=CD))
     out["dispatch"] = row
 
+    # shard: the sharded supervisors' key-ownership splitter (one batch ->
+    # N masked sub-batches, parallel/sharding.py) — the only per-batch cost
+    # shard-local supervision adds; also the pack step of a reshard handoff
+    from ..batch import Batch
+    from ..parallel.sharding import ShardAssignment
+    CS, NS = 8192, 4
+    assign = ShardAssignment(NS)
+    sb = Batch.of({"v": jnp.asarray(rng.random(CS).astype(np.float32))},
+                  key=jnp.asarray(rng.integers(0, 64, CS).astype(np.int32)))
+    out["shard"] = {"elems": CS,
+                    "seconds": _bench_one(assign.split_fn(), sb, reps=reps)}
+
     for row in out.values():
         row["ns_per_elem"] = round(row.pop("seconds") / row["elems"] * 1e9, 3)
     return out
@@ -488,6 +528,7 @@ def measure(skip_proxy: bool = False, reps: int = 3) -> dict:
     report = {"workloads": {name: workload_cost(name) for name in WORKLOADS}}
     for name in SCAN_WORKLOADS:
         report["workloads"][name] = workload_scan_cost(name)
+    report["workloads"]["reshard_pack"] = reshard_pack_cost()
     if not skip_proxy:
         report["proxy"] = proxy_microbench(reps=reps)
     return report

@@ -91,6 +91,21 @@ WF110  warn/err  scan dispatch (K > 1) combined with a configuration
                  a ring's capacity (warning, the WF106 shape — a full
                  fused group can never be ring-resident, so the
                  consumer always flushes short on the linger)
+WF115  warn/err  shard-local supervision (``shards=``/``WF_SHARDS``)
+                 combined with a configuration its per-shard recovery
+                 contract cannot honor: an unresolvable shard count or
+                 re-sharding plan (error); scan dispatch K > 1 (error
+                 — a fused group failure has no single shard's replay
+                 extent); tiered keyed state (error — one process-wide
+                 HostStore per operator, a shard restore could roll
+                 back peers); wall-clock admission or sequence-id
+                 tracing under sharded supervision (error, the
+                 WF105/WF108 mirror); a re-sharding plan whose move
+                 targets a nonexistent shard (error); more shards than
+                 a keyed operator's key space (error — empty shards) /
+                 an indivisible key space (warning — uneven ranges);
+                 shard fault sites in a plan while shards resolve to 1
+                 (warning — the specs could never fire)
 ====== ========= =====================================================
 
 Usage::
@@ -292,7 +307,8 @@ DRIVER_SITES = {
     "threaded": frozenset({"source.next", "queue.stall", "chain.step",
                            "sink.consume"}),
     "supervised": frozenset({"source.next", "chain.step", "sink.consume",
-                             "checkpoint.save", "checkpoint.load"}),
+                             "checkpoint.save", "checkpoint.load",
+                             "shard.kill", "reshard.handoff"}),
 }
 
 
@@ -338,6 +354,183 @@ def _check_faults(report, faults, driver: str) -> None:
                 f"fault site {spec.site!r} is never threaded through the "
                 f"{driver!r} driver — the spec can never fire",
                 hint=f"sites this driver fires: {fired}")
+
+
+#: shard-only fault sites (warned as WF115 when a plan schedules them while
+#: shards resolve to 1 — they could never fire, the WF103 shape)
+_SHARD_SITES = frozenset({"shard.kill", "reshard.handoff"})
+
+
+def _check_shards(report, shards_arg, reshard_arg, ops, cfg, trace,
+                  stored_trace, dispatch, stored_dispatch, faults,
+                  where: str, shard_key=None) -> None:
+    """WF115: shard-local supervision (``runtime/supervisor.py``
+    ``ShardedSupervisor``) against configurations its per-shard recovery /
+    deterministic re-sharding contracts cannot honor."""
+    from ..parallel.sharding import ReshardPlan, resolve_shards
+    from ..runtime import faults as _faults
+    if reshard_arg is None:
+        # mirror the drivers: reshard=None consults WF_RESHARD — an
+        # env-driven plan must get the same legality checks as an explicit
+        # one (the resolve_shards parity rule)
+        try:
+            reshard_arg = ReshardPlan.resolve(None)
+        except (ValueError, TypeError, KeyError) as e:
+            report.add("WF115", "error", f"{where}:reshard",
+                       f"WF_RESHARD does not parse: {e}",
+                       hint="WF_RESHARD is an int shard count, JSON "
+                            "{'at_pos', 'new_shards', 'moves'}, or 'auto'")
+            reshard_arg = None
+    try:
+        n = resolve_shards(shards_arg)
+    except (ValueError, TypeError) as e:
+        report.add("WF115", "error", f"{where}:shards",
+                   f"shard count does not resolve: {e}",
+                   hint="shards= (or WF_SHARDS) must be an integer >= 1; "
+                        "1/unset = single supervision domain")
+        return
+    # shard sites scheduled but sharding off: the WF103 can-never-fire shape
+    plan = None
+    if isinstance(faults, _faults.FaultInjector):
+        plan = faults.plan
+    elif isinstance(faults, _faults.FaultPlan):
+        plan = faults
+    elif faults is None:
+        try:
+            plan = _faults.FaultPlan.from_env()
+        except (ValueError, OSError):
+            plan = None                    # already a WF103 error
+    if n <= 1:
+        if plan is not None:
+            for i, spec in enumerate(plan.faults):
+                if spec.site in _SHARD_SITES:
+                    report.add(
+                        "WF115", "warning", f"faults[{i}]",
+                        f"fault site {spec.site!r} is scheduled but shards "
+                        f"resolve to 1 — the spec can never fire",
+                        hint="pass shards=N (or WF_SHARDS=N) to run the "
+                             "sharded supervisor, or drop the spec")
+        if reshard_arg is not None and reshard_arg is not False:
+            report.add("WF115", "warning", f"{where}:reshard",
+                       "a reshard plan is configured but shards resolve to "
+                       "1 — it can never apply",
+                       hint="pass shards=N (or WF_SHARDS=N); re-sharding "
+                            "runs only under sharded supervision")
+        return
+    # -- sharded: composition checks --------------------------------------
+    from ..runtime.dispatch import DispatchConfig
+    try:
+        dcfg = (DispatchConfig.resolve(dispatch) if dispatch is not None
+                else DispatchConfig.resolve(stored_dispatch))
+    except (ValueError, TypeError):
+        dcfg = None                        # already a WF110 error
+    if dcfg is not None and dcfg.k > 1:
+        report.add(
+            "WF115", "error", f"{where}:shards",
+            f"shards={n} does not compose with scan dispatch (K={dcfg.k}): "
+            f"a fused group failure cannot be attributed to one shard's "
+            f"replay extent",
+            hint="drop dispatch=/WF_DISPATCH (per-shard pushes amortize "
+                 "dispatch across shards already), or run shards=1")
+    tiered = [op.getName() for op in ops
+              if getattr(op, "_tier_cfg", None) is not None]
+    if tiered:
+        report.add(
+            "WF115", "error", f"{where}:shards",
+            f"shards={n} does not compose with tiered keyed state "
+            f"({', '.join(tiered)}): the per-operator HostStore is one "
+            f"process-wide cold tier, so a shard-local restore could roll "
+            f"back a peer shard's spilled rows",
+            hint="run tiered tables with shards=1, or size the hot tables "
+                 "for the full key space and keep tiered= off")
+    if cfg is not None and cfg.admission and cfg.refill_per_batch is None:
+        report.add(
+            "WF115", "error", f"{where}:shards",
+            "wall-clock admission under SHARDED supervision: a shard-local "
+            "replay must re-shed exactly what the failed attempt shed "
+            "(the WF105 contract, per key range)",
+            hint="use ControlConfig(refill_per_batch=...) — the "
+                 "deterministic positional bucket")
+    tcfg = _resolve_trace(trace, stored_trace)
+    if tcfg is not None and getattr(tcfg, "ids", "position") != "position":
+        report.add(
+            "WF115", "error", f"{where}:shards",
+            "sequence-id tracing under SHARDED supervision: a shard replay "
+            "would mint fresh ids for its key range (the WF108 contract)",
+            hint="use TraceConfig(ids='position') — the default")
+    # a KeyBy re-keys the stream: ownership is computed at INGEST, so
+    # without a shard_key= the re-keyed group scatters across shards and
+    # every shard holds a partial (wrong) per-key state
+    if shard_key is None:
+        from ..operators.map import KeyBy
+        rekeys = [op.getName() for op in ops if isinstance(op, KeyBy)]
+        if rekeys:
+            report.add(
+                "WF115", "error", f"{where}:shards",
+                f"shards={n} with a KeyBy re-key ({', '.join(rekeys)}) and "
+                f"no shard_key=: ownership follows the ingest key, so a "
+                f"re-keyed group's tuples scatter across shards (partial "
+                f"per-key state, wrong results)",
+                hint="pass shard_key=<the KeyBy's fn> (TupleRef -> key) so "
+                     "ownership follows the key the state tables use")
+    # per-key-range geometry: shards vs every keyed operator's key space
+    for op in ops:
+        nk = getattr(op, "num_keys", None)
+        if not isinstance(nk, int) or nk <= 1:
+            continue
+        opw = f"{where}:{op.getName()}"
+        if n > nk:
+            report.add(
+                "WF115", "error", opw,
+                f"shards={n} exceeds the operator's key space "
+                f"(num_keys={nk}): at least {n - nk} shard(s) own no keys "
+                f"and can never make progress against their restart budget",
+                hint=f"use shards <= {nk} (key ownership is key % shards)")
+        elif nk % n:
+            report.add(
+                "WF115", "warning", opw,
+                f"num_keys={nk} is not divisible by shards={n}: key ranges "
+                f"are uneven (largest shard owns "
+                f"{-(-nk // n)} keys, smallest {nk // n})",
+                hint="a shard count dividing the key space balances load "
+                     "(and matches any key-axis mesh sharding downstream)")
+    # re-sharding plan legality (the nonexistent-shard check)
+    if reshard_arg is not None and reshard_arg is not False:
+        try:
+            rplan = ReshardPlan.resolve(reshard_arg)
+        except (ValueError, TypeError, KeyError) as e:
+            report.add("WF115", "error", f"{where}:reshard",
+                       f"reshard plan does not resolve: {e}",
+                       hint="pass a ReshardPlan, dict {'at_pos', "
+                            "'new_shards', 'moves'}, an int shard count, "
+                            "or 'auto'")
+            return
+        if rplan == "auto" or rplan is None:
+            return
+        target_n = rplan.new_shards if rplan.new_shards is not None else n
+        if target_n < 1:
+            report.add("WF115", "error", f"{where}:reshard",
+                       f"reshard plan requests new_shards={target_n} (< 1)",
+                       hint="the target shard count must be >= 1")
+            return
+        for k, s in rplan.moves:
+            if not (0 <= s < target_n):
+                report.add(
+                    "WF115", "error", f"{where}:reshard",
+                    f"reshard plan moves key {k} to shard {s}, which does "
+                    f"not exist in the target layout ({target_n} shards)",
+                    hint=f"move targets must be in [0, {target_n})")
+
+
+def _resolve_trace(trace, stored_trace):
+    """Resolved TraceConfig honoring explicit-over-stored (the WF108
+    resolution, shared with the WF115 sequence-id mirror)."""
+    from ..observability import TraceConfig
+    try:
+        return (TraceConfig.resolve(trace) if trace is not None
+                else TraceConfig.resolve(stored_trace))
+    except (ValueError, TypeError):
+        return None                        # already diagnosed as WF108
 
 
 def _check_watermarks(report, cfg, edges) -> None:
@@ -821,7 +1014,8 @@ def _validate_pipeline(report, p, faults, control, supervised,
 
 
 def _validate_supervised(report, sp, faults, control, trace=None,
-                         dispatch=None) -> None:
+                         dispatch=None, shards=None, reshard=None,
+                         shard_key=None) -> None:
     cfg = _resolve_control(control, getattr(sp, "_control", None))
     in_spec = _source_spec(report, sp.source,
                            f"source:{sp.source.getName()}")
@@ -840,6 +1034,17 @@ def _validate_supervised(report, sp, faults, control, trace=None,
     _check_health(report, getattr(sp, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(sp, "_dispatch_arg", None),
                     cfg, trace, getattr(sp, "_trace_arg", None), True)
+    _check_shards(report,
+                  shards if shards is not None
+                  else getattr(sp, "_shards", None),
+                  reshard if reshard is not None
+                  else getattr(sp, "_reshard_arg", None),
+                  sp.chain.ops, cfg, trace, getattr(sp, "_trace_arg", None),
+                  dispatch, getattr(sp, "_dispatch_arg", None),
+                  faults if faults is not None
+                  else getattr(sp, "_faults_arg", None), "supervised",
+                  shard_key=(shard_key if shard_key is not None
+                             else getattr(sp, "_shard_key", None)))
 
 
 def _validate_threaded(report, tp, faults, control, supervised,
@@ -913,7 +1118,8 @@ def _check_graph_edges(report, g, cfg) -> None:
 
 
 def _validate_graph(report, g, faults, control, supervised,
-                    threaded, trace=None, dispatch=None) -> None:
+                    threaded, trace=None, dispatch=None, shards=None,
+                    reshard=None, shard_key=None) -> None:
     from ..basic import DEFAULT_BATCH_SIZE
     from ..control import ControlConfig
     from ..runtime.pipeline import resolve_batch_hint
@@ -999,6 +1205,15 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_dispatch(report, dispatch, getattr(g, "_dispatch_arg", None),
                     cfg, trace, getattr(g, "_trace_arg", None), supervised,
                     edges=dedges)
+    if supervised:
+        # run unconditionally: shards=None consults WF_SHARDS inside
+        # _check_shards (the run_graph_supervised resolution) — an
+        # env-driven sharded run must get the same WF115 coverage as an
+        # explicit one
+        _check_shards(report, shards, reshard, g._operators, cfg, trace,
+                      getattr(g, "_trace_arg", None), dispatch,
+                      getattr(g, "_dispatch_arg", None), faults, "graph",
+                      shard_key=shard_key)
 
 
 def _validate_compiled_chain(report, chain, faults, control,
@@ -1017,8 +1232,8 @@ def _validate_compiled_chain(report, chain, faults, control,
 
 
 def validate(obj, *, faults=None, control=None, supervised: bool = None,
-             threaded: bool = False, trace=None,
-             dispatch=None) -> ValidationReport:
+             threaded: bool = False, trace=None, dispatch=None,
+             shards=None, reshard=None, shard_key=None) -> ValidationReport:
     """Validate a built-but-not-run driver object; returns a
     :class:`ValidationReport` (never raises on findings — call
     ``.raise_if_errors()`` to gate).
@@ -1045,7 +1260,14 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     ``dispatch``: a ``DispatchConfig``/bool/int K/dict overriding the
     object's own stored ``dispatch=`` argument for the WF110 scan-dispatch
     checks; ``None`` consults the stored argument and ``WF_DISPATCH``
-    (mirroring the drivers)."""
+    (mirroring the drivers).
+
+    ``shards``/``reshard``/``shard_key``: the shard count, re-sharding
+    plan, and ownership-key override destined for the sharded supervisors,
+    for the WF115 checks — a ``SupervisedPipeline`` consults its own
+    stored arguments when these are None; for a ``PipeGraph`` pass the
+    values you will pass to ``run_supervised`` (with ``supervised=True``;
+    ``shards=None`` consults ``WF_SHARDS``, mirroring the driver)."""
     from ..runtime.pipegraph import PipeGraph
     from ..runtime.pipeline import CompiledChain, Pipeline
     from ..runtime.supervisor import SupervisedPipeline
@@ -1054,10 +1276,12 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     if isinstance(obj, PipeGraph):
         report = ValidationReport(f"PipeGraph({obj.name!r})")
         _validate_graph(report, obj, faults, control, bool(supervised),
-                        threaded, trace, dispatch)
+                        threaded, trace, dispatch, shards, reshard,
+                        shard_key)
     elif isinstance(obj, SupervisedPipeline):
         report = ValidationReport("SupervisedPipeline")
-        _validate_supervised(report, obj, faults, control, trace, dispatch)
+        _validate_supervised(report, obj, faults, control, trace, dispatch,
+                             shards, reshard, shard_key)
     elif isinstance(obj, ThreadedPipeline):
         report = ValidationReport("ThreadedPipeline")
         _validate_threaded(report, obj, faults, control, bool(supervised),

@@ -115,3 +115,37 @@ def governor_from_config(cfg, clock=time.monotonic,
         return None
     return BackpressureGovernor(cfg.high_watermark, cfg.low_watermark,
                                 cfg.throttle_poll_s, clock=clock)
+
+
+def recommend_reshard(loads, assignment, *, hot_fraction: float = 0.6,
+                      max_shards: int = 64, min_load: float = 1.0):
+    """The governor's re-sharding planner — a PURE function from per-shard
+    load signals to a :class:`~windflow_tpu.parallel.sharding.ReshardPlan`
+    (or None).
+
+    ``loads``: per-shard load, e.g. the sharded supervisor's committed
+    ``interval_tuples`` (a pure function of stream position, so
+    supervised replay re-derives the identical plan), or live queue depths
+    for an external operator. ``assignment``: the current
+    ``ShardAssignment`` (or a bare shard count). Doubling is recommended
+    when the hottest shard carries more than ``hot_fraction`` of the TOTAL
+    load — a scale-free signal (a max/mean ratio would grow with the shard
+    count even on a perfectly balanced layout whenever active keys are
+    fewer than shards): ``key % 2N`` splits every shard (the hot one
+    included) in two without shuffling keys between survivors.
+    Deterministic; never wall-clock."""
+    vals = [float(v) for v in
+            (loads.values() if isinstance(loads, dict) else loads)]
+    if not vals:
+        return None
+    total = sum(vals)
+    if total / len(vals) < float(min_load):
+        return None                       # nothing measured yet
+    if max(vals) < float(hot_fraction) * total:
+        return None
+    n = getattr(assignment, "num_shards", None)
+    n = int(assignment) if n is None else int(n)
+    if n * 2 > int(max_shards):
+        return None
+    from ..parallel.sharding import ReshardPlan
+    return ReshardPlan(new_shards=n * 2)

@@ -618,6 +618,18 @@ def merge_snapshots(snaps: Sequence[dict],
         if skews:
             sec["edge_skew_ts"] = skews
         out["event_time"] = sec
+    # shard-local supervision: per-shard rows are folded HOST-TAGGED
+    # (``host/shard``), never summed — a fleet view that summed shard
+    # gauges could not name WHICH shard is hot, which is the whole point
+    # of the per-shard health surface (names.py::SHARD_GAUGES)
+    shard_secs = [(h, s.get("shards")) for h, s in zip(hosts, snaps)
+                  if s.get("shards")]
+    if shard_secs:
+        ssec: dict = {}
+        for host, rows in shard_secs:
+            for k, row in rows.items():
+                ssec[f"{host}/{k}"] = dict(row)
+        out["shards"] = ssec
     # health ledgers: devices concatenated (host-tagged), footprints and
     # compile counters summed, device-time summed with the dispatch-bound
     # classifier recomputed over the fleet totals

@@ -290,6 +290,14 @@ class MetricsRegistry:
     def attach_gauge(self, name: str, fn: Callable[[], Any]) -> None:
         self._gauges[name] = fn
 
+    def attach_shards(self, provider: Callable[[], dict]) -> None:
+        """Register the sharded supervisor's per-shard health provider
+        (``shard_report()``: {shard idx -> names.py::SHARD_GAUGES row}) —
+        rendered as the snapshot's ``shards`` section and folded
+        HOST-TAGGED (never summed) by ``device_health.merge_snapshots``,
+        so the fleet view names WHICH shard is hot."""
+        self._shards_provider = provider
+
     def attach_queue_gauge(self, edge: str, fn: Callable[[], int],
                            capacity: Optional[int] = None) -> None:
         """SPSC ring depth probe for one dataflow edge (threaded driver):
@@ -512,6 +520,15 @@ class MetricsRegistry:
             snap["queue_capacity"] = dict(self._queue_capacities)
         if gauges:
             snap["gauges"] = gauges
+        shards_fn = getattr(self, "_shards_provider", None)
+        if shards_fn is not None:
+            try:
+                rows = shards_fn()
+            except Exception:       # noqa: BLE001 — never kill a snapshot
+                rows = None
+            if rows:
+                # string keys: the section round-trips through JSON
+                snap["shards"] = {str(k): dict(v) for k, v in rows.items()}
         if self.event_time:
             et = self._event_time_section(et_secs)
             if et:

@@ -68,6 +68,13 @@ JOURNAL_EVENTS = (
     # rows handed back to the device tier on probe miss — both carry
     # (table, n, total); emitted on the driver thread only
     "spill", "readmit",
+    # shard-local supervision (runtime/supervisor.py ShardedSupervisor):
+    # "shard_restore" = ONE shard restored + replayed its own key range
+    # while peers kept serving (shard id + replay extent: replay_from/
+    # at_batch); "reshard" = a live re-sharding span (from_shards/
+    # to_shards/moves/at_pos; discarded=True marks an in-flight handoff
+    # manifest dropped on restore — replay re-derives the move)
+    "shard_restore", "reshard",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -166,6 +173,21 @@ EVENT_TIME_GAUGES = (
     "min_watermark", "skew",               # graph frontier + per-edge skew
 )
 
+#: per-SHARD gauges of the ``shards`` snapshot section (the shard-local
+#: supervision layer's health surface: ``SupervisedPipeline.shard_report``
+#: -> ``MetricsRegistry.attach_shards`` -> snapshot ``shards`` rows,
+#: rendered per shard by ``scripts/wf_health.py``/``wf_state.py`` and
+#: folded HOST-TAGGED (never summed — the fleet view must name WHICH
+#: shard is hot) by ``device_health.merge_snapshots``)
+SHARD_GAUGES = (
+    "occupancy_tuples",     # live tuples this shard processed since commit
+    "restarts",             # shard-local recoveries (global restarts excluded)
+    "last_recovery_s",      # duration of the most recent shard restore+replay
+    "dead_letters",         # sub-batches this shard quarantined
+    "reshard_moves",        # times this shard's key range changed in a reshard
+    "committed_pos",        # stream position of the shard's last commit
+)
+
 #: runtime-health gauges of the ``health`` snapshot section
 #: (``MonitoringConfig.health`` / ``WF_MONITORING_HEALTH``;
 #: ``metrics.py::_prometheus_health`` renders ONLY registered names — its
@@ -218,6 +240,11 @@ PERF_PROXY_FAMILIES = (
     # join_table_tier_evict: coldness sort + outbox pack + slot clear) —
     # the device-side half of the HBM->host spill protocol
     "spill",
+    # "shard" times the sharded supervisor's key-ownership splitter
+    # (parallel/sharding.py ShardAssignment.split_fn — the per-batch
+    # program the reshard_pack AOT pin also covers): one masked split
+    # into N sub-batches, the only per-batch cost sharding adds
+    "shard",
 )
 
 #: Nexmark-style benchmark queries (``windflow_tpu/nexmark/queries.py``).

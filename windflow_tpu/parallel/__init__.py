@@ -1,5 +1,7 @@
 from .mesh import make_mesh, make_mesh_2d, leading_axis_sharding, replicated
-from .sharding import ShardedChain, shard_batch, batch_sharding
+from .sharding import (ShardedChain, shard_batch, batch_sharding,
+                       ShardAssignment, ReshardPlan, make_splitter,
+                       affected_shards, resolve_shards)
 from .emitters import (Basic_Emitter, Standard_Emitter, Broadcast_Emitter,
                        Splitting_Emitter, Tree_Emitter)
 from .ordering import Ordering_Node
@@ -10,6 +12,8 @@ from . import multihost
 __all__ = [
     "make_mesh", "make_mesh_2d", "leading_axis_sharding", "replicated",
     "ShardedChain", "shard_batch", "batch_sharding",
+    "ShardAssignment", "ReshardPlan", "make_splitter", "affected_shards",
+    "resolve_shards",
     "Basic_Emitter", "Standard_Emitter", "Broadcast_Emitter",
     "Splitting_Emitter", "Tree_Emitter", "Ordering_Node",
     "wmr_map_reduce", "ring_pane_windows", "keyed_all_to_all",

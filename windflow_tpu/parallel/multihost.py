@@ -111,6 +111,23 @@ def _factor(n: int, k: int) -> Tuple[int, ...]:
     return (n // f,) + _factor(f, k - 1) if k == 2 else (f,) + _factor(n // f, k - 1)
 
 
+def process_shard_slice(num_shards: int) -> Tuple[int, int]:
+    """Contiguous shard-index range ``[lo, hi)`` supervised by THIS process —
+    the multi-host layout of the shard-local supervision layer
+    (``runtime/supervisor.py`` ``ShardedSupervisor``): process ``i`` of ``P``
+    owns shards ``[i*ceil(N/P), ...)``, so each host runs its own
+    per-shard recovery domains over its own key ranges (pass the slice as
+    ``SupervisedPipeline(shards=N, shard_range=...)``) and writes its own
+    per-shard checkpoint files — a failed host's peers keep serving their
+    shards, which is the whole point. Degenerates to ``(0, num_shards)``
+    single-process."""
+    p, i = jax.process_count(), jax.process_index()
+    per = -(-int(num_shards) // p)
+    lo = min(i * per, int(num_shards))
+    hi = min(lo + per, int(num_shards))
+    return lo, hi
+
+
 def process_local_batch_range(total: int, batch_size: int) -> Tuple[int, int]:
     """Partition a global stream of ``total`` tuples across processes: each host's
     source generates/ingests only its contiguous share (the multi-host Source

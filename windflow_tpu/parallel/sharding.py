@@ -15,7 +15,8 @@ Usage::
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +75,233 @@ def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
 def shard_batch(batch: Batch, mesh: Mesh, axis: str = "dp") -> Batch:
     s = batch_sharding(mesh, axis)
     return jax.tree.map(lambda a: jax.device_put(a, s), batch)
+
+
+# ----------------------------------------------------------- key ownership
+
+@dataclasses.dataclass(frozen=True)
+class ShardAssignment:
+    """Deterministic key-slot -> shard ownership map of the shard-local
+    supervision layer (``runtime/supervisor.py`` ``ShardedSupervisor``).
+
+    Base rule: ``owner(key) = key % num_shards`` — the reference's
+    ``hash(key) % pardegree`` KF_Emitter routing (``wf/standard_emitter.hpp``)
+    applied at the supervision boundary (key slots are already hashed at
+    ingest by ``batch.hash_key_to_slot``). ``moves`` is a small tuple of
+    ``(key_slot, shard)`` overrides — the governor-driven re-sharding plan's
+    targeted key moves. Doubling ``num_shards`` splits every shard in two
+    (``key % 2N ≡ key % N (mod N)``), so a ``4 -> 8`` reshard never shuffles
+    keys between surviving pairs.
+
+    Pure data + a cached jitted splitter: the assignment is JSON-serializable
+    (``to_meta``/``from_meta``) so checkpoints record the layout epoch and a
+    supervised replay re-derives IDENTICAL shard assignments."""
+
+    num_shards: int
+    moves: Tuple[Tuple[int, int], ...] = ()
+
+    def __post_init__(self):
+        if int(self.num_shards) < 1:
+            raise ValueError(f"ShardAssignment: num_shards must be >= 1, "
+                             f"got {self.num_shards}")
+        norm = tuple(sorted((int(k), int(s)) for k, s in self.moves))
+        if len({k for k, _s in norm}) != len(norm):
+            # the host-side owner() (first match) and the traced owner_of()
+            # (last jnp.where) would disagree on the duplicate's owner —
+            # reshard planning would then rebuild the wrong shard
+            dupes = sorted({k for k, _s in norm
+                            if sum(1 for kk, _ in norm if kk == k) > 1})
+            raise ValueError(
+                f"ShardAssignment: key slot(s) {dupes} appear in more than "
+                f"one move — each key has exactly one owner")
+        for k, s in norm:
+            if not (0 <= s < self.num_shards):
+                raise ValueError(
+                    f"ShardAssignment: move {k} -> shard {s} references a "
+                    f"nonexistent shard (have {self.num_shards})")
+        object.__setattr__(self, "num_shards", int(self.num_shards))
+        object.__setattr__(self, "moves", norm)
+
+    # -- ownership ---------------------------------------------------------
+
+    def owner_of(self, keys):
+        """Owning shard per key slot (array in, array out; works traced)."""
+        own = keys % jnp.asarray(self.num_shards, keys.dtype)
+        for k, s in self.moves:
+            own = jnp.where(keys == k, jnp.asarray(s, own.dtype), own)
+        return own
+
+    def owner(self, key_slot: int) -> int:
+        """Host-side single-key owner (reshard planning / tests)."""
+        for k, s in self.moves:
+            if k == int(key_slot):
+                return s
+        return int(key_slot) % self.num_shards
+
+    # -- the splitter (reshard_pack: the perf-gate-pinned program) ---------
+
+    def split_fn(self):
+        """ONE jitted program mapping a batch to its ``num_shards`` masked
+        sub-batches: lane content is preserved verbatim, each sub-batch's
+        ``valid`` is intersected with key ownership — so the union of live
+        lanes over all shards is exactly the input's live lanes (no key
+        dropped, no key duplicated). Cached per assignment; jax.jit caches
+        one executable per batch shape — one host dispatch per input batch
+        regardless of shard count. (The ``batch.key``-owned form; see
+        :func:`make_splitter` for derived ownership keys.)"""
+        fn = getattr(self, "_split_jit", None)
+        if fn is None:
+            fn = make_splitter(self)
+            object.__setattr__(self, "_split_jit", fn)
+        return fn
+
+    def split(self, batch: Batch):
+        """``[sub_batch_0, ..., sub_batch_{N-1}]`` for one input batch."""
+        if self.num_shards == 1:
+            return [batch]
+        return list(self.split_fn()(batch))
+
+    # -- serialization (checkpoint layout epoch) ---------------------------
+
+    def to_meta(self) -> dict:
+        return {"num_shards": self.num_shards,
+                "moves": [[k, s] for k, s in self.moves]}
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "ShardAssignment":
+        return cls(int(meta["num_shards"]),
+                   tuple((int(k), int(s)) for k, s in meta.get("moves", ())))
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """A deterministic live re-sharding request, applied by the sharded
+    supervisors at the first checkpoint barrier at-or-after ``at_pos``
+    (barrier alignment is what makes replay re-derive the identical layout:
+    the plan's effect is a pure function of committed stream position).
+
+    ``new_shards``: the target shard count (None keeps the current count);
+    ``moves``: targeted ``(key_slot, shard)`` overrides applied on top —
+    the governor's hot-key rebalancing. Parsed from ``WF_RESHARD``
+    (``"8"`` = double/grow to 8 at the next barrier, or full JSON
+    ``{"at_pos": 64, "new_shards": 8, "moves": [[3, 1]]}``)."""
+
+    new_shards: Optional[int] = None
+    moves: Tuple[Tuple[int, int], ...] = ()
+    at_pos: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "moves",
+                           tuple((int(k), int(s)) for k, s in self.moves))
+        if self.new_shards is not None:
+            object.__setattr__(self, "new_shards", int(self.new_shards))
+        object.__setattr__(self, "at_pos", int(self.at_pos))
+
+    def apply_to(self, cur: ShardAssignment) -> ShardAssignment:
+        """The new layout (validates move targets via ShardAssignment)."""
+        n = self.new_shards if self.new_shards is not None else cur.num_shards
+        # carry surviving targeted moves forward only when the shard count is
+        # unchanged — a count change re-bases every key to key % N (the
+        # deterministic split rule), and stale overrides would pin moved keys
+        # to the OLD layout's hot-spot decisions
+        base = cur.moves if n == cur.num_shards else ()
+        merged = dict(base)
+        merged.update(dict(self.moves))
+        return ShardAssignment(n, tuple(merged.items()))
+
+    @classmethod
+    def resolve(cls, arg) -> Optional["ReshardPlan"]:
+        """Normalize a driver's ``reshard=`` argument: None consults
+        ``WF_RESHARD``; False forces off; "auto" passes through as the
+        governor-driven sentinel (the caller handles it); a plan/dict/int/
+        JSON string parses."""
+        if arg is False:
+            return None
+        if isinstance(arg, cls):
+            return arg
+        if arg is None:
+            import os
+            raw = os.environ.get("WF_RESHARD", "").strip()
+            if not raw:
+                return None
+            arg = raw
+        if isinstance(arg, str):
+            if arg == "auto":
+                return "auto"  # type: ignore[return-value]
+            import json
+            arg = json.loads(arg) if arg[:1] in "[{" else int(arg)
+        if isinstance(arg, int):
+            return cls(new_shards=arg)
+        if isinstance(arg, dict):
+            return cls(new_shards=arg.get("new_shards"),
+                       moves=tuple((int(k), int(s))
+                                   for k, s in arg.get("moves", ())),
+                       at_pos=arg.get("at_pos", 0))
+        raise TypeError(f"reshard= accepts a ReshardPlan, dict, int, JSON "
+                        f"string, 'auto', or None/False — got {type(arg)}")
+
+
+def make_splitter(assignment: ShardAssignment, key_fn=None):
+    """Jitted ``batch -> (sub_batch_0, ..., sub_batch_{N-1})`` splitter.
+
+    ``key_fn`` (``TupleRef -> int`` key, the KeyBy convention) overrides the
+    batch's ``key`` control field as the OWNERSHIP key. It is required
+    whenever the stateful operators group on a derived key — a ``KeyBy``
+    downstream, or an operator ``key_fn`` over a payload field that differs
+    from the ingest key: ownership must follow the key the state tables
+    use, or one group's tuples would scatter across shards and every shard
+    would hold a partial (wrong) per-key state. The validator's WF115 flags
+    the detectable case (a KeyBy under sharded supervision without a
+    ``shard_key=``)."""
+    n = assignment.num_shards
+
+    def split(batch: Batch):
+        if key_fn is None:
+            keys = batch.key
+        else:
+            from ..batch import tuple_refs
+            keys = jnp.asarray(jax.vmap(key_fn)(tuple_refs(batch)),
+                               batch.key.dtype)
+        own = assignment.owner_of(keys)
+        return tuple(batch.replace(valid=batch.valid & (own == s))
+                     for s in range(n))
+    return jax.jit(split)
+
+
+def affected_shards(old: ShardAssignment, new: ShardAssignment) -> set:
+    """New-layout shard indices whose key set changes between ``old`` and
+    ``new`` — the shards the re-sharding handoff must rebuild (the rest
+    adopt their state untouched). A shard-count change affects every shard
+    (``key % N`` re-bases all ranges — though a doubling only ever SPLITS
+    each old shard, it still changes every new index's key set); with the
+    count unchanged only the donor/recipient shards of the targeted moves
+    are affected."""
+    if old.num_shards != new.num_shards:
+        return set(range(new.num_shards))
+    out = set()
+    for k in ({k for k, _ in old.moves} | {k for k, _ in new.moves}):
+        a, b = old.owner(k), new.owner(k)
+        if a != b:
+            out.add(a)
+            out.add(b)
+    return out
+
+
+def resolve_shards(arg) -> int:
+    """Normalize a driver's ``shards=`` argument: None consults ``WF_SHARDS``
+    (unset/empty/0/1 all mean OFF — the single-supervision-domain path,
+    byte-for-byte today's code); an int passes through (0 = off, the env
+    convention; negative is an error)."""
+    if arg is None:
+        import os
+        raw = os.environ.get("WF_SHARDS", "").strip()
+        arg = int(raw) if raw else 1
+    n = int(arg)
+    if n == 0:
+        return 1                          # '0' means off, per ENV_FLAGS.md
+    if n < 0:
+        raise ValueError(f"shards= must be >= 0, got {n}")
+    return n
 
 
 class ShardedChain:

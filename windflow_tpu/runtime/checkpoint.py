@@ -78,16 +78,6 @@ def _flatten(states) -> Dict[str, np.ndarray]:
     return out
 
 
-def _chain_arrays(chain: CompiledChain) -> Dict[str, np.ndarray]:
-    """Device states + (for tiered operators) the settled cold-tier
-    manifests — ONE array namespace, so the per-array sha256 map and the
-    atomic write cover the host stores exactly like device state."""
-    chain.tier_settle()
-    out = _flatten(chain.states)
-    out.update(chain.tier_manifests())
-    return out
-
-
 def _leaf_paths(states) -> Dict[str, list]:
     """``{"op<i>": [keystr, ...]}`` of every state leaf, in flatten order."""
     out = {}
@@ -201,11 +191,28 @@ def save_chain(chain: CompiledChain, path: str, *, meta: dict = None,
     ``<stem>.<seq>.npz`` and updates ``<stem>.manifest.json`` (entries carry a
     whole-file sha256; pruned to the last ``keep`` files). ``load_chain`` on
     the same ``path`` then restores the newest valid entry."""
+    return save_states(chain.states, path, meta=meta, keep=keep,
+                       extra_arrays=_extra_chain_arrays(chain))
+
+
+def _extra_chain_arrays(chain: CompiledChain) -> Dict[str, np.ndarray]:
+    chain.tier_settle()
+    return chain.tier_manifests()
+
+
+def save_states(states, path: str, *, meta: dict = None, keep: int = 1,
+                extra_arrays: Optional[Dict[str, np.ndarray]] = None) -> str:
+    """The states-level core of :func:`save_chain` — also the per-shard save
+    of :func:`save_sharded` (each shard's state list rides the SAME atomic
+    write + per-array sha256 + ``keep=K`` lineage machinery under its own
+    file stem, so per-shard lineages fall back independently)."""
     path = resolve_path(path)
-    arrays = _chain_arrays(chain)
+    arrays = _flatten(states)
+    if extra_arrays:
+        arrays.update(extra_arrays)
     full_meta = dict(meta or {})
     full_meta[_META_SHA] = _digest_map(arrays)
-    full_meta[_META_PATHS] = _leaf_paths(chain.states)
+    full_meta[_META_PATHS] = _leaf_paths(states)
     spec = _faults.decision("checkpoint.save", path=path)
     if keep <= 1:
         raw = _to_npz_bytes(_serialize(arrays, full_meta))
@@ -253,12 +260,32 @@ def save_chain(chain: CompiledChain, path: str, *, meta: dict = None,
 
 def _restore_file(chain: CompiledChain, path: str,
                   expect_file_sha: Optional[str] = None) -> dict:
-    """Verify + restore one checkpoint file in place; returns the user meta.
+    """Verify + restore one checkpoint file in place; returns the user meta."""
+    new_states, meta, extra = _load_states_file(
+        chain.states, path, expect_file_sha=expect_file_sha,
+        tier_ops=getattr(chain, "_tier_ops", ()))
+    chain.states = new_states
+    # tiered cold tiers: restore from the tier* namespace (a pre-tiering
+    # checkpoint has none — the fresh empty store stands, and any in-flight
+    # spill copies of the failed attempt are discarded either way)
+    chain.tier_restore_manifests(
+        {k: v for k, v in extra.items() if k.startswith("tier")})
+    return meta
+
+
+def _load_states_file(states, path: str,
+                      expect_file_sha: Optional[str] = None,
+                      tier_ops=()) -> tuple:
+    """Verify one checkpoint file against a states template and return
+    ``(new_states, user_meta, extra_arrays)`` — the states-level core of
+    :func:`_restore_file`, shared with the per-shard loads of
+    :func:`load_sharded` (``extra_arrays`` carries every non-state array,
+    e.g. the ``tier*`` cold-tier manifests).
 
     Legacy compatibility: a checkpoint written before a state dataclass grew a
     trailing field (e.g. Win_SeqFFAT's ``dropped_old`` counter) is short by
     those leaves — registered dataclasses flatten in field order, so the
-    missing keys are exactly the tail. Absent leaves keep the chain's
+    missing keys are exactly the tail. Absent leaves keep the template's
     freshly-initialized value (zeros for counters) instead of raising — the
     same stance as the supervisor's legacy-``wm`` mapping."""
     if expect_file_sha is not None and _file_sha256(path) != expect_file_sha:
@@ -284,7 +311,7 @@ def _restore_file(chain: CompiledChain, path: str,
                     f"checkpoint {path!r}: array {k} fails its sha256 — "
                     f"corrupt data, refusing a silent partial restore")
     new_states = []
-    for i, st in enumerate(chain.states):
+    for i, st in enumerate(states):
         leaves, treedef = jax.tree.flatten(st)
         saved_paths = (paths_map or {}).get(f"op{i}")
         if saved_paths is not None:
@@ -322,7 +349,7 @@ def _restore_file(chain: CompiledChain, path: str,
         # legacy file (no path map): positional restore. Refuse it for a
         # tiered operator — the tier fields interleave into the flatten
         # order, so positional matching would silently misassign arrays
-        if any(j == i for j in getattr(chain, "_tier_ops", ())):
+        if any(j == i for j in tier_ops):
             raise KeyError(
                 f"checkpoint {path!r} predates leaf-path metadata and "
                 f"op{i} has tiered state — a positional restore would "
@@ -346,24 +373,19 @@ def _restore_file(chain: CompiledChain, path: str,
         restored = [jax.numpy.asarray(data[f"op{i}_leaf{j}"]) if have[j]
                     else leaves[j] for j in range(len(leaves))]
         new_states.append(jax.tree.unflatten(treedef, restored))
-    chain.states = new_states
-    # tiered cold tiers: restore from the tier* namespace (a pre-tiering
-    # checkpoint has none — the fresh empty store stands, and any in-flight
-    # spill copies of the failed attempt are discarded either way)
-    chain.tier_restore_manifests(
-        {k: data[k] for k in present if k.startswith("tier")})
-    return meta
+    extra = {k: data[k] for k in present
+             if k != "__meta__" and not k.startswith("op")}
+    return new_states, meta, extra
 
 
-def load_chain(chain: CompiledChain, path: str) -> dict:
-    """Restore states in place; returns the saved metadata dict.
-
-    When ``path`` has a lineage manifest (``save_chain(..., keep=K)``), walks
-    the entries newest→oldest and restores the newest checkpoint that passes
-    verification — a torn or corrupt latest file falls back to the previous
-    commit (journaled as ``checkpoint_fallback``) instead of failing the
-    restore. Without a manifest, a single invalid file raises
-    :class:`CheckpointCorrupt` (or ``KeyError`` for a chain mismatch)."""
+def _walk_lineage(path: str, restore_one):
+    """THE newest-valid-entry fallback protocol, shared by
+    :func:`load_chain` and :func:`load_states`: fire the ``checkpoint.load``
+    site, then — when ``path`` has a lineage manifest — try
+    ``restore_one(file, expect_sha)`` newest→oldest, journaling skipped
+    entries (``checkpoint_invalid``) and the fallback
+    (``checkpoint_fallback``); without a manifest, one direct
+    ``restore_one(path, None)``."""
     path = resolve_path(path)
     _faults.fire("checkpoint.load", path=path)
     man = _read_manifest(manifest_path(path))
@@ -374,8 +396,7 @@ def load_chain(chain: CompiledChain, path: str) -> dict:
         for ent in reversed(man["entries"]):
             f = os.path.join(d, ent["file"])
             try:
-                meta = _restore_file(chain, f,
-                                     expect_file_sha=ent.get("sha256"))
+                result = restore_one(f, ent.get("sha256"))
             except (CheckpointCorrupt, KeyError, OSError) as e:
                 last_err = e
                 skipped.append(ent["file"])
@@ -387,8 +408,242 @@ def load_chain(chain: CompiledChain, path: str) -> dict:
                 _faults.bump("checkpoint_fallbacks")
                 _journal.record("checkpoint_fallback", restored=ent["file"],
                                 skipped=skipped)
-            return meta
+            return result
         raise CheckpointCorrupt(
             f"no valid checkpoint in lineage {path!r} "
             f"({len(man['entries'])} entries, all torn/corrupt)") from last_err
-    return _restore_file(chain, path)
+    return restore_one(path, None)
+
+
+def load_states(states, path: str) -> tuple:
+    """States-level :func:`load_chain`: restore against a template states
+    list, returning ``(new_states, meta)`` with the same lineage-manifest
+    newest-valid fallback — each sharded-checkpoint shard walks its OWN
+    lineage here, so one shard's torn latest file degrades that shard to its
+    previous commit without touching its peers."""
+    def restore_one(f, sha):
+        new_states, meta, _extra = _load_states_file(states, f,
+                                                     expect_file_sha=sha)
+        return new_states, meta
+    return _walk_lineage(path, restore_one)
+
+
+# ------------------------------------------------------- sharded checkpoints
+
+#: shards-manifest schema version
+_SHARDS_VERSION = 1
+
+
+def shard_stem(path: str, shard: int) -> str:
+    """File stem of one shard's checkpoint (its own atomic-write + lineage
+    namespace): ``<stem>.shard<k>`` beside the unsharded ``<stem>.npz``."""
+    return resolve_path(path)[:-len(".npz")] + f".shard{int(shard)}"
+
+
+def shards_manifest_path(path: str, shard_ids=None) -> str:
+    """The sharded-checkpoint manifest name. A FULL save (all shards) owns
+    ``<stem>.shards.json``; a multi-host SLICE owns a deterministic
+    per-slice name (``<stem>.shards.s2-3.json``) so concurrent hosts on a
+    shared filesystem can never clobber each other's manifests —
+    :func:`load_sharded` merges every ``<stem>.shards*.json`` and verifies
+    the union covers the layout."""
+    stem = resolve_path(path)[:-len(".npz")]
+    if shard_ids is None:
+        return stem + ".shards.json"
+    ids = sorted(int(i) for i in shard_ids)
+    return stem + f".shards.s{ids[0]}-{ids[-1]}.json"
+
+
+def save_sharded(shard_states, path: str, *, layout: dict,
+                 meta: dict = None, keep: int = 1,
+                 parallel: bool = True, shard_ids=None) -> dict:
+    """Sharded-and-parallel checkpoint: one file (or ``keep=K`` lineage) PER
+    SHARD over the existing atomic-write + per-array sha256 machinery, the
+    saves fanned out across a thread pool, committed by an atomic
+    ``<stem>.shards.json`` manifest written LAST — readers only ever see
+    shard files named by a fully-written manifest, so a crash mid-fan-out
+    degrades to the previous sharded commit.
+
+    ``layout`` is the serialized :class:`~windflow_tpu.parallel.sharding.
+    ShardAssignment` (``to_meta()``) — the layout epoch a restore re-derives
+    shard ownership from. Returns the manifest dict written."""
+    shard_states = list(shard_states)
+    ids = (list(range(len(shard_states))) if shard_ids is None
+           else [int(i) for i in shard_ids])
+    n = int(layout.get("num_shards", len(shard_states)))
+    meta = dict(meta or {})
+
+    def save_one(j):
+        return save_states(shard_states[j], shard_stem(path, ids[j]),
+                           meta={**meta, "shard": ids[j], "num_shards": n},
+                           keep=keep)
+    if parallel and len(ids) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(len(ids), 8)) as ex:
+            files = list(ex.map(save_one, range(len(ids))))
+    else:
+        files = [save_one(j) for j in range(len(ids))]
+    man = {"version": _SHARDS_VERSION, "num_shards": n, "layout": dict(layout),
+           "meta": meta,
+           "shards": [{"shard": k, "file": os.path.basename(f)}
+                      for k, f in zip(ids, files)]}
+    # a multi-host slice writes only ITS shards' entries, under a PER-SLICE
+    # manifest name — two hosts sharing a filesystem can never clobber each
+    # other (last-writer-wins on one file would silently lose half the key
+    # space); the full single-host save owns the plain .shards.json
+    full = ids == list(range(n))
+    _write_manifest(shards_manifest_path(path, None if full else ids), man)
+    return man
+
+
+def load_sharded(template_states, path: str) -> tuple:
+    """Restore a :func:`save_sharded` checkpoint: reads the shards manifest,
+    then restores each shard against ``template_states`` (fresh per-op init
+    values) — verification and lineage fallback run PER SHARD, so one
+    shard's corrupt file never forces a global fallback. Returns
+    ``(list_of_states_per_shard, layout, meta)``; raises
+    :class:`CheckpointCorrupt` when the manifest is missing/torn."""
+    import glob
+    stem = resolve_path(path)[:-len(".npz")]
+    mans = []
+    for mp_ in sorted(glob.glob(stem + ".shards*.json")):
+        man = _read_manifest(mp_)
+        if man and "num_shards" in man:
+            mans.append(man)
+    if not mans:
+        raise CheckpointCorrupt(
+            f"no sharded-checkpoint manifest at "
+            f"{shards_manifest_path(path)!r} (or any per-slice "
+            f"{os.path.basename(stem)}.shards.s*-*.json beside it)")
+    n = int(mans[0]["num_shards"])
+    layout = dict(mans[0].get("layout", {}))
+    for man in mans[1:]:
+        if int(man["num_shards"]) != n or dict(man.get("layout", {})) \
+                != layout:
+            raise CheckpointCorrupt(
+                f"sharded-checkpoint manifests under {stem!r} disagree on "
+                f"the layout epoch — mixed-generation slices; clear the "
+                f"stale manifests and re-save")
+    # NEWEST generation first (batches_done, missing -> oldest): a stale
+    # per-slice manifest left behind by a deployment-shape switch (slices
+    # -> full save, or back) must never override a fresher manifest's
+    # entries for the same shards — per shard, the first (newest) manifest
+    # naming it wins
+    mans.sort(key=lambda m: -(m.get("meta", {}).get("batches_done")
+                              if isinstance(m.get("meta", {})
+                                            .get("batches_done"), int)
+                              else -1))
+    entries = {}
+    for man in mans:
+        for ent in man.get("shards", []):
+            entries.setdefault(int(ent["shard"]),
+                               (ent, dict(man.get("meta", {}))))
+    missing = sorted(set(range(n)) - set(entries))
+    if missing:
+        raise CheckpointCorrupt(
+            f"sharded checkpoint {stem!r} covers only shards "
+            f"{sorted(entries)} of {n} — shard(s) {missing} missing "
+            f"(a host's slice never committed); refusing a silent "
+            f"partial restore")
+    d = os.path.dirname(resolve_path(path)) or "."
+    out = {}
+    shard_meta = {}
+    for k in sorted(entries):
+        ent, man_meta = entries[k]
+        # restore the MANIFEST-NAMED file — the manifest is the commit
+        # point, so a shard whose lineage already advanced past it (saves
+        # fanned out, crash before the manifest rewrite) must restore the
+        # committed generation, not its newest file; only a torn committed
+        # file falls back to the shard's own lineage walk
+        try:
+            states, meta_k, _extra = _load_states_file(
+                template_states, os.path.join(d, ent["file"]))
+        except (CheckpointCorrupt, KeyError, OSError):
+            states, meta_k = load_states(template_states,
+                                         shard_stem(path, k))
+        meta_k = {kk: v for kk, v in meta_k.items()
+                  if kk not in ("shard", "num_shards")}
+        # generation cross-check: a shard AHEAD of its manifest is the
+        # torn keep=1 fan-out (the overwritten file is the only copy of
+        # the new generation and the old one is gone) — loud, with the
+        # fix; a shard BEHIND is the legitimate per-shard lineage
+        # fallback, surfaced via meta["shard_meta"] for reconciliation
+        if (meta_k.get("batches_done") is not None
+                and man_meta.get("batches_done") is not None
+                and meta_k["batches_done"] > man_meta["batches_done"]):
+            raise CheckpointCorrupt(
+                f"sharded checkpoint {stem!r}: shard {k} is at "
+                f"batches_done={meta_k['batches_done']}, AHEAD of its "
+                f"manifest ({man_meta['batches_done']}) — a crash between "
+                f"the shard fan-out and the manifest rewrite overwrote "
+                f"the committed generation; save with checkpoint_keep >= "
+                f"2 so the manifest-named lineage entry survives the "
+                f"next fan-out")
+        out[k] = states
+        shard_meta[k] = meta_k
+    meta = dict(mans[0].get("meta", {}))
+    meta["shard_meta"] = shard_meta
+    return out, layout, meta
+
+
+# ------------------------------------------------- re-sharding handoff seal
+
+def handoff_path(path: str, shard: int) -> str:
+    return resolve_path(path)[:-len(".npz")] + f".handoff{int(shard)}.npz"
+
+
+def seal_handoff(shard_states, path: str, *, layout: dict,
+                 at_pos: int) -> list:
+    """Phase 1 of the re-sharding handoff: seal every retiring shard's
+    drained state to a ``<stem>.handoff<k>.npz`` manifest (atomic + sha256,
+    the HostStore-manifest wire format: plain named arrays). The seal is
+    NOT a commit — the sharded-checkpoint manifest still names the old
+    layout, so a crash between seal and the new layout's first commit
+    leaves only orphan handoff files for :func:`discard_handoffs`."""
+    files = []
+    for k, states in enumerate(shard_states):
+        spec = _faults.decision("reshard.handoff", shard=k, at_pos=at_pos)
+        f = handoff_path(path, k)
+        arrays = _flatten(states)
+        hmeta = {"layout": dict(layout), "at_pos": int(at_pos), "shard": k,
+                 _META_SHA: _digest_map(arrays),
+                 _META_PATHS: _leaf_paths(states)}
+        raw = _to_npz_bytes(_serialize(arrays, hmeta))
+        if spec is not None:
+            if spec.kind == "torn":
+                _write_torn(f, raw, spec)
+            raise _faults.InjectedFault(
+                spec.message or f"injected reshard.handoff fault at {f}")
+        _atomic_write_bytes(f, raw)
+        files.append(f)
+    return files
+
+
+def discard_handoffs(path: str) -> list:
+    """Drop every in-flight handoff manifest under ``path`` (the restore
+    rule: a checkpoint that lands mid-handoff discards the seal — replay
+    re-derives the move deterministically at the same barrier). Returns the
+    discarded file names."""
+    import glob
+    stem = resolve_path(path)[:-len(".npz")]
+    dropped = []
+    for f in sorted(glob.glob(stem + ".handoff*.npz")):
+        try:
+            os.unlink(f)
+            dropped.append(os.path.basename(f))
+        except OSError:
+            pass
+    return dropped
+
+
+def load_chain(chain: CompiledChain, path: str) -> dict:
+    """Restore states in place; returns the saved metadata dict.
+
+    When ``path`` has a lineage manifest (``save_chain(..., keep=K)``), walks
+    the entries newest→oldest and restores the newest checkpoint that passes
+    verification — a torn or corrupt latest file falls back to the previous
+    commit (journaled as ``checkpoint_fallback``) instead of failing the
+    restore. Without a manifest, a single invalid file raises
+    :class:`CheckpointCorrupt` (or ``KeyError`` for a chain mismatch)."""
+    return _walk_lineage(
+        path, lambda f, sha: _restore_file(chain, f, expect_file_sha=sha))

@@ -42,9 +42,16 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..observability import journal as _journal
 from ..observability.names import RECOVERY_COUNTERS
 
-#: the named injection sites threaded through the runtime drivers
+#: the named injection sites threaded through the runtime drivers.
+#: ``shard.kill`` fires before every per-shard push of the sharded
+#: supervisors (ctx: shard=, pos= — ``where={"shard": 2}`` kills exactly
+#: one shard's steps, the kill-one-of-N chaos drill); ``reshard.handoff``
+#: fires inside the two-phase re-sharding handoff (kind="torn" leaves the
+#: half-sealed handoff manifest behind, then raises — what restore must
+#: discard and replay must re-derive).
 SITES = ("source.next", "chain.step", "sink.consume",
-         "checkpoint.save", "checkpoint.load", "queue.stall")
+         "checkpoint.save", "checkpoint.load", "queue.stall",
+         "shard.kill", "reshard.handoff")
 
 #: fault kinds: raise an InjectedFault / sleep stall_s (watchdog + queue-stall
 #: exercise) / leave a half-written checkpoint behind, then raise (torn write)
