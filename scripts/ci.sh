@@ -1,12 +1,15 @@
 #!/usr/bin/env bash
 # ci.sh — the whole local gate in one command, one combined exit code:
 #
-#   wf_lint (framework-invariant linter, exit 0/1/2)
+#   wf_lint (framework-invariant linter + WF26x concurrency pass, exit 0/1/2)
 #     -> wf_perfgate (hermetic AOT cost pins + proxy microbenches, 0/1/2)
 #     -> tier-1 tests (the ROADMAP.md verify command)
 #
 # Every step runs even when an earlier one failed (the full picture in one
-# pass); the exit code is nonzero iff ANY step failed. Usage:
+# pass); the exit code is nonzero iff ANY step failed.  A per-step duration
+# summary prints at the end, and the wf_lint row carries its finding count
+# (fresh + baselined) so a glance at the summary says whether the gate is
+# clean or riding suppressions.  Usage:
 #
 #   scripts/ci.sh              # everything
 #   scripts/ci.sh --fast      # lint + perfgate only (seconds, no pytest)
@@ -14,16 +17,32 @@ set -u
 cd "$(dirname "$0")/.."
 
 overall=0
+step_names=()
+step_rcs=()
+step_secs=()
+step_notes=()
+
 run_step() {
     local name="$1"; shift
     echo "==================== ${name} ===================="
-    "$@"
-    local rc=$?
-    if [ $rc -ne 0 ]; then
+    local out; out=$(mktemp)
+    local t0=$SECONDS
+    "$@" 2>&1 | tee "$out"
+    local rc=${PIPESTATUS[0]}
+    local dt=$((SECONDS - t0))
+    local note=""
+    if [ "$name" = "wf_lint" ]; then
+        # the one-line verdict ("wf_lint: N finding(s) (M baselined)")
+        note=$(grep -a '^wf_lint:' "$out" | tail -1 | sed 's/^wf_lint: //')
+    fi
+    rm -f "$out"
+    step_names+=("$name"); step_rcs+=("$rc")
+    step_secs+=("$dt"); step_notes+=("$note")
+    if [ "$rc" -ne 0 ]; then
         echo "ci: ${name} FAILED (rc=${rc})" >&2
         overall=1
     else
-        echo "ci: ${name} ok"
+        echo "ci: ${name} ok${note:+ — ${note}}"
     fi
 }
 
@@ -37,6 +56,13 @@ if [ "${1:-}" != "--fast" ]; then
         --continue-on-collection-errors -p no:cacheprovider
 fi
 
+echo "==================== summary ===================="
+for i in "${!step_names[@]}"; do
+    status=ok
+    [ "${step_rcs[$i]}" -ne 0 ] && status="FAILED(rc=${step_rcs[$i]})"
+    printf 'ci: %-14s %-14s %5ss%s\n' "${step_names[$i]}" "$status" \
+        "${step_secs[$i]}" "${step_notes[$i]:+  ${step_notes[$i]}}"
+done
 if [ $overall -ne 0 ]; then
     echo "ci: FAILED" >&2
 else

@@ -8,10 +8,20 @@ a pre-commit hook on any box:
     python scripts/wf_lint.py                    # text report
     python scripts/wf_lint.py --format=json      # machine-readable
     python scripts/wf_lint.py --update-baseline  # accept current findings
+    python scripts/wf_lint.py --select WF26x     # only the concurrency pass
+    python scripts/wf_lint.py --ignore WF230     # everything but one code
+    python scripts/wf_lint.py --explain WF261    # what a code means
+
+``--select``/``--ignore`` take comma-separated codes; a trailing ``x``
+matches a family (``WF26x`` = WF260..WF269, ``WF2x`` = everything).
+Filtering happens BEFORE the baseline split, so a selected run behaves
+exactly like the gate restricted to those codes — handy for triaging a new
+rule family in isolation (scripts/ci.sh always runs the full set).
 
 Exit codes: 0 = clean (no non-baselined findings), 1 = findings, 2 =
 internal error (the linter itself failed — never confuse a broken gate
-with a clean one).
+with a clean one; an unknown code in --select/--ignore/--explain is a
+broken invocation, also 2).
 
 Baseline: ``windflow_tpu/analysis/baseline.json`` suppresses pre-existing
 findings (override with ``--baseline`` or the ``WF_LINT_BASELINE`` env var);
@@ -39,6 +49,55 @@ def _load_lint():
     return mod
 
 
+def _parse_codes(lint, text: str):
+    """``--select``/``--ignore`` tokens -> concrete code set.  A trailing
+    ``x`` matches a family by prefix (``WF26x``, ``WF2x``) — the prefix
+    must be ``WF`` plus at least one digit, or a typo like ``x`` would
+    match EVERYTHING and (under --ignore) silently disable the whole gate;
+    exact tokens must name a registered rule (silently selecting nothing
+    would turn the gate into a no-op — both are broken invocations,
+    exit 2)."""
+    import re
+    codes = set()
+    for tok in [t.strip() for t in text.split(",") if t.strip()]:
+        if re.fullmatch(r"WF\d+x", tok):
+            fam = [c for c in lint.RULES if c.startswith(tok[:-1])]
+            if not fam:
+                raise ValueError(f"unknown rule family {tok!r}")
+            codes.update(fam)
+        elif tok in lint.RULES:
+            codes.add(tok)
+        else:
+            raise ValueError(
+                f"unknown rule code {tok!r} (see --explain, or the RULES "
+                f"table in windflow_tpu/analysis/lint.py)")
+    return codes
+
+
+def _explain(lint, code: str) -> int:
+    if code not in lint.RULES:
+        print(f"wf_lint: unknown rule code {code!r}; registered codes: "
+              f"{', '.join(sorted(lint.RULES))}", file=sys.stderr)
+        return 2
+    severity, summary = lint.RULES[code]
+    print(f"{code} [{severity}] {summary}")
+    # the long-form story lives in the implementing module's docstring —
+    # print the matching table row block for context
+    doc_mod = (lint.concurrency_module() if code.startswith("WF26")
+               else lint)
+    doc = doc_mod.__doc__ or ""
+    in_block = False
+    for line in doc.splitlines():
+        if line.strip().startswith(code):
+            in_block = True
+        elif in_block and (line.strip().startswith("WF")
+                           or line.strip().startswith("=====")):
+            break
+        if in_block:
+            print(line)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="wf_lint", description="windflow_tpu framework invariant linter")
@@ -53,15 +112,48 @@ def main(argv=None) -> int:
                          "and exit 0")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignoring the baseline")
+    ap.add_argument("--select", default=None, metavar="CODES",
+                    help="comma-separated codes/families to run in "
+                         "isolation (WF230 or WF26x); others are dropped "
+                         "before the baseline split")
+    ap.add_argument("--ignore", default=None, metavar="CODES",
+                    help="comma-separated codes/families to drop")
+    ap.add_argument("--explain", default=None, metavar="WFnnn",
+                    help="print what a rule code means and exit")
     args = ap.parse_args(argv)
 
     try:
         lint = _load_lint()
+        if args.explain:
+            return _explain(lint, args.explain)
+        if args.update_baseline and (args.select or args.ignore):
+            # a filtered run sees a subset — banking it would ERASE the
+            # suppressions for every other code (ratchet corruption);
+            # checked BEFORE the (multi-second) lint run
+            print("wf_lint: refusing --update-baseline with "
+                  "--select/--ignore (a partial baseline would drop "
+                  "the other codes' suppressions)", file=sys.stderr)
+            return 2
+        # validate the code filters up front: a typo'd code must fail fast
+        # as a broken invocation, not after a full repo scan
+        keep = _parse_codes(lint, args.select) if args.select else None
+        drop = _parse_codes(lint, args.ignore) if args.ignore else None
         cfg = lint.LintConfig(root=args.root)
+        wf26x = {c for c in lint.RULES if c.startswith("WF26")}
+        if (keep is not None and not (keep & wf26x)) \
+                or (drop is not None and wf26x <= drop):
+            # the run cannot surface any WF26x finding (none selected, or
+            # the whole family ignored): skip the whole-repo concurrency
+            # index/inference instead of discarding its findings
+            cfg.concurrency = False
         if args.baseline:
             # resolve against the INVOKER's cwd, not the lint root
             os.environ["WF_LINT_BASELINE"] = os.path.abspath(args.baseline)
         findings = lint.run_lint(cfg=cfg)
+        if keep is not None:
+            findings = [x for x in findings if x.code in keep]
+        if drop is not None:
+            findings = [x for x in findings if x.code not in drop]
         bpath = lint.baseline_path(cfg)
         if args.update_baseline:
             lint.save_baseline(bpath, findings)

@@ -29,6 +29,13 @@ WF250  error     kernel/impl name at a ``register_kernel``/
                  registries (``observability/names.py::KERNELS`` /
                  ``KERNEL_IMPLS``) — a typo'd kernel name silently
                  forks the env-override/tuning-cache/WF109 namespaces
+WF26x  —         the whole-repo static CONCURRENCY pass (thread-role
+                 inference, inferred lock discipline WF260, role
+                 constraints WF261, ordered effects WF262, lock-order
+                 cycles WF263, unjoined threads WF264, grammar WF265)
+                 — implemented in the sibling ``concurrency.py``
+                 (loaded by path, still no JAX), run by ``run_lint``
+                 by default, findings ride this module's baseline
 ====== ========= =====================================================
 
 Annotation grammar (one per physical line; for a multi-line statement the
@@ -57,11 +64,53 @@ import dataclasses
 import json
 import os
 import re
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # --------------------------------------------------------------- findings
 
 SEVERITIES = ("error", "warning")
+
+#: THE lint rule table — one row per diagnostic code, shared by this module,
+#: the concurrency pass (``analysis/concurrency.py``, the WF26x family), and
+#: the CLI's ``--select``/``--ignore``/``--explain`` surface, so the help
+#: text can never drift from the registered codes.  Values:
+#: ``(severity, one-line summary)``.
+RULES: Dict[str, Tuple[str, str]] = {
+    "WF200": ("error", "scanned file fails to parse (the linter cannot "
+                       "see it)"),
+    "WF201": ("error", "WF_* env read missing from docs/ENV_FLAGS.md"),
+    "WF202": ("error", "ENV_FLAGS.md row does not state WHEN the flag is "
+                       "read (trace time / run time / process start)"),
+    "WF210": ("error", "wall-clock / random use inside a deterministic-"
+                       "replay module without allow[wall-clock]"),
+    "WF220": ("error", "attribute declared guarded-by[<lock>] accessed "
+                       "outside `with self.<lock>:`"),
+    "WF230": ("warning", "bare except / except Exception without a "
+                         "noqa: BLE001 rationale"),
+    "WF240": ("error", "journal event/span name not in "
+                       "names.py::JOURNAL_EVENTS"),
+    "WF241": ("error", "counter/gauge name not in the central names.py "
+                       "registries"),
+    "WF250": ("error", "kernel/impl name at register_kernel/resolve_impl "
+                       "not in names.py::KERNELS / KERNEL_IMPLS"),
+    # -- the WF26x concurrency family (analysis/concurrency.py) -----------
+    "WF260": ("error", "cross-thread-role mutable attribute without one "
+                       "consistent lock or a guarded-by/single-writer "
+                       "annotation"),
+    "WF261": ("error", "function reachable from a thread role outside its "
+                       "declared thread-role[...] set (e.g. a driver-"
+                       "thread-only API called from a spawned thread)"),
+    "WF262": ("error", "io_callback in a deterministic-replay module "
+                       "without a literal ordered=True, or with an "
+                       "unresolvable callback"),
+    "WF263": ("warning", "lock-order cycle (potential deadlock) in the "
+                         "lock-acquisition graph"),
+    "WF264": ("warning", "non-daemon thread started with no reachable "
+                         "join() on the shutdown path"),
+    "WF265": ("error", "wf-lint concurrency annotation grammar error "
+                       "(unknown role / empty role list)"),
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,6 +163,15 @@ class LintConfig:
     #: the central name registries (parsed with ast, never imported)
     names_file: str = os.path.join("windflow_tpu", "observability", "names.py")
     baseline: str = os.path.join("windflow_tpu", "analysis", "baseline.json")
+    #: replay-sensitive modules for the WF262 ordered-effect rule — None =
+    #: the concurrency pass's default (the deterministic set above plus the
+    #: operator modules whose compiled programs embed host callbacks);
+    #: fixture tests point it at their module under test
+    replay_modules: Optional[Sequence[str]] = None
+    #: run the whole-repo concurrency pass (analysis/concurrency.py,
+    #: WF26x) as part of run_lint — on by default; fixture tests for the
+    #: WF2xx rules may disable it to stay single-concern
+    concurrency: bool = True
 
 
 _ALLOW_RE = re.compile(r"#\s*wf-lint:\s*allow\[([a-z0-9_,\- ]+)\]")
@@ -695,6 +753,38 @@ def rule_parse_errors(cfg: LintConfig, files: List[_File]) -> List[Finding]:
             for f in files if f.parse_error is not None]
 
 
+_CONCURRENCY_MOD = None
+
+
+def concurrency_module():
+    """Load the sibling ``concurrency.py`` by file path (NOT via the
+    package — this module itself is path-loaded by ``scripts/wf_lint.py``
+    in environments without JAX, where ``windflow_tpu.__init__`` cannot
+    import)."""
+    global _CONCURRENCY_MOD
+    if _CONCURRENCY_MOD is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "concurrency.py")
+        spec = importlib.util.spec_from_file_location(
+            "wf_analysis_concurrency", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["wf_analysis_concurrency"] = mod
+        spec.loader.exec_module(mod)
+        _CONCURRENCY_MOD = mod
+    return _CONCURRENCY_MOD
+
+
+def rule_concurrency(cfg: LintConfig) -> List[Finding]:
+    """The WF26x whole-repo concurrency pass (thread-role inference,
+    inferred lock discipline, ordered effects, lock order, unjoined
+    threads) — implemented in ``analysis/concurrency.py``, surfaced here so
+    its findings ride the same baseline ratchet and CLI as WF2xx."""
+    conc = concurrency_module()
+    return [Finding(**d) for d in conc.run_rules(
+        cfg.root, cfg.package_dirs, replay_modules=cfg.replay_modules)]
+
+
 def run_lint(root: str = None, cfg: LintConfig = None) -> List[Finding]:
     """Run every rule over the tree; findings sorted by (path, line, code)."""
     if cfg is None:
@@ -710,6 +800,8 @@ def run_lint(root: str = None, cfg: LintConfig = None) -> List[Finding]:
     findings += rule_broad_except(cfg, files)
     findings += rule_emitted_names(cfg, files)
     findings += rule_kernel_names(cfg, files)
+    if cfg.concurrency:
+        findings += rule_concurrency(cfg)
     return sorted(findings, key=lambda x: (x.path, x.line, x.code))
 
 

@@ -41,7 +41,9 @@ class BackpressureGovernor:
         #: pause hook (pass it as ``pause_event=`` to ``batches_prefetched``)
         self.pause_event = threading.Event()
         self.throttles = 0                    # episodes (per-governor, tests)
-        self._edges: List[Tuple[str, Callable[[], int], int, int]] = []
+        # watch() registers every edge on the driver BEFORE the source/stage
+        # threads start throttling; throttle() only iterates
+        self._edges: List[Tuple[str, Callable[[], int], int, int]] = []  # wf-lint: single-writer[driver]
         self._stop = threading.Event()
 
     def watch(self, edge: str, size_fn: Callable[[], int],

@@ -259,7 +259,8 @@ def parallel_unpack(records, workers: int = None, fields=None):
             as_cp(rec_base + lo * dt.itemsize), m, dt.itemsize, nf,
             offs, szs, dsts)
 
-    threads = [threading.Thread(target=one, args=(bounds[w], bounds[w + 1]))
+    threads = [threading.Thread(target=one,  # wf-lint: thread-role[native]
+                                args=(bounds[w], bounds[w + 1]))
                for w in range(workers)]
     for t in threads:
         t.start()

@@ -65,64 +65,92 @@ class LogHistogram:
             if exemplar is not None:
                 self.exemplars[i] = exemplar
 
-    def _percentile_bucket(self, q: float) -> Optional[int]:
+    def _snap(self) -> tuple:
+        """One consistent ``(counts, count, sum, min, max, exemplars)``
+        read — the reporter thread summarizes while driver/stage threads
+        record, so every read-side path (incl. the registry's cross-replica
+        merge) works off a locked snapshot instead of walking the live
+        fields (a torn counts/count pair would misplace a percentile, and
+        iterating the live exemplars dict while record() inserts raises;
+        surfaced by the WF260 concurrency lint)."""
+        with self._lock:
+            return (list(self.counts), self.count, self.sum, self.min,
+                    self.max, dict(self.exemplars))
+
+    @staticmethod
+    def _bucket_of(counts: List[int], count: int, q: float) -> Optional[int]:
         """Index of the bucket holding the q-th sample; None when empty."""
-        if not self.count:
+        if not count:
             return None
-        target = max(1, int(q / 100.0 * self.count + 0.5))
+        target = max(1, int(q / 100.0 * count + 0.5))
         acc = 0
-        for i, c in enumerate(self.counts):
+        for i, c in enumerate(counts):
             acc += c
             if acc >= target:
                 return i
-        return len(self.counts) - 1
+        return len(counts) - 1
 
-    def percentile(self, q: float) -> float:
-        """Approximate q-th percentile (q in [0, 100]): the upper bound of the
-        bucket holding the q-th sample — an overestimate by at most one bucket
-        width (factor sqrt(2))."""
-        i = self._percentile_bucket(q)
+    @classmethod
+    def _pct_value(cls, counts: List[int], count: int, mx: float,
+                   q: float) -> float:
+        """q-th percentile from one snapshot: the upper bound of the bucket
+        holding the q-th sample (overflow bucket -> observed max) — an
+        overestimate by at most one bucket width (factor sqrt(2)).  THE one
+        bucket-to-value rule; percentile() and summary_us() both use it."""
+        i = cls._bucket_of(counts, count, q)
         if i is None:
             return 0.0
         if i >= _N_BUCKETS:                      # overflow bucket
-            return self.max
-        return min(self.BOUNDS[i], self.max)
+            return mx
+        return min(cls.BOUNDS[i], mx)
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100])."""
+        counts, count, _sum, _mn, mx, _ex = self._snap()
+        return self._pct_value(counts, count, mx, q)
 
     def exemplar(self, q: float) -> Optional[int]:
         """Trace id of the last sample that landed in the q-th percentile's
         bucket (None when empty or never traced) — THE link from a histogram
         line to a concrete batch in the flight recorder."""
-        i = self._percentile_bucket(q)
-        return None if i is None else self.exemplars.get(i)
+        counts, count, _sum, _mn, _mx, exemplars = self._snap()
+        i = self._bucket_of(counts, count, q)
+        return None if i is None else exemplars.get(i)
 
     @property
     def mean(self) -> float:
-        return self.sum / self.count if self.count else 0.0
+        _counts, count, total, _mn, _mx, _ex = self._snap()
+        return total / count if count else 0.0
 
     def summary_us(self) -> Dict[str, float]:
-        """p50/p95/p99 + mean in microseconds (the snapshot's unit).  When
-        tracing supplied exemplars, ``p99_exemplar`` names the trace id of
-        the last batch that landed in the p99 bucket."""
+        """p50/p95/p99 + mean in microseconds (the snapshot's unit), all
+        computed from ONE consistent snapshot.  When tracing supplied
+        exemplars, ``p99_exemplar`` names the trace id of the last batch
+        that landed in the p99 bucket."""
+        counts, count, total, _mn, mx, exemplars = self._snap()
+        pct = lambda q: self._pct_value(counts, count, mx, q)
         out = {
-            "p50": round(self.percentile(50) * 1e6, 3),
-            "p95": round(self.percentile(95) * 1e6, 3),
-            "p99": round(self.percentile(99) * 1e6, 3),
-            "mean": round(self.mean * 1e6, 3),
-            "max": round(self.max * 1e6, 3) if self.count else 0.0,
-            "samples": self.count,
+            "p50": round(pct(50) * 1e6, 3),
+            "p95": round(pct(95) * 1e6, 3),
+            "p99": round(pct(99) * 1e6, 3),
+            "mean": round((total / count if count else 0.0) * 1e6, 3),
+            "max": round(mx * 1e6, 3) if count else 0.0,
+            "samples": count,
         }
-        ex = self.exemplar(99)
+        i99 = self._bucket_of(counts, count, 99)
+        ex = None if i99 is None else exemplars.get(i99)
         if ex is not None:
             out["p99_exemplar"] = ex
         return out
 
     def prometheus_buckets(self):
         """Cumulative (le_seconds, count) pairs, Prometheus histogram form."""
+        counts, count, _sum, _mn, _mx, _ex = self._snap()
         out, acc = [], 0
-        for i, c in enumerate(self.counts[:_N_BUCKETS]):
+        for i, c in enumerate(counts[:_N_BUCKETS]):
             acc += c
             out.append((self.BOUNDS[i], acc))
-        out.append((float("inf"), self.count))
+        out.append((float("inf"), count))
         return out
 
 
@@ -261,16 +289,24 @@ class MetricsRegistry:
         self.event_time = bool(event_time)
         self.created = time.monotonic()
         self.e2e_hist = LogHistogram()       # source framing -> sink host receipt
-        self._graphs: List[Any] = []
-        self._pipelines: List[Any] = []
-        self._chains: List[tuple] = []       # (label, CompiledChain)
-        self._operators: List[Any] = []
-        self._gauges: Dict[str, Callable[[], Any]] = {}
-        self._queue_gauges: Dict[str, Callable[[], int]] = {}
-        self._queue_capacities: Dict[str, int] = {}
+        # registration happens on the driver while the graph is being built,
+        # BEFORE the Monitor starts the reporter thread (happens-before via
+        # Thread.start); the reporter tick only iterates — checked by the
+        # WF260 concurrency lint, these annotations are its rationale
+        self._graphs: List[Any] = []          # wf-lint: single-writer[driver]
+        self._pipelines: List[Any] = []       # wf-lint: single-writer[driver]
+        # (label, CompiledChain)
+        self._chains: List[tuple] = []        # wf-lint: single-writer[driver]
+        self._operators: List[Any] = []       # wf-lint: single-writer[driver]
+        self._gauges: Dict[str, Callable[[], Any]] = {}  # wf-lint: single-writer[driver]
+        self._queue_gauges: Dict[str, Callable[[], int]] = {}  # wf-lint: single-writer[driver]
+        self._queue_capacities: Dict[str, int] = {}  # wf-lint: single-writer[driver]
         # id(op) -> (t, inputs, outputs)  # wf-lint: guarded-by[_lock]
         self._prev: Dict[int, tuple] = {}
-        self._et_names: Dict[int, str] = {}   # id(op) -> name (event_time)
+        # written only inside snapshot(): reporter ticks are one thread, and
+        # a driver-side snapshot (Reporter.stop final emit) runs only after
+        # the tick thread is joined
+        self._et_names: Dict[int, str] = {}   # wf-lint: single-writer[reporter]
         self._lock = threading.Lock()
 
     # -- registration -----------------------------------------------------------------
@@ -400,18 +436,25 @@ class MetricsRegistry:
                     v = sum(getattr(r, k, 0) for r in recs)
                     row[k] = v
                     totals[k] += v
-                # service-time distribution: merged across replicas
+                # service-time distribution: merged across replicas — each
+                # replica read through its locked _snap() (stage threads
+                # record concurrently; raw-field reads here were the torn-
+                # count/mutating-dict race the WF260 lint surfaced)
                 merged = LogHistogram()
                 for r in recs:
                     h = getattr(r, "service_hist", None)
-                    if h is not None and h.count:
-                        for i, c in enumerate(h.counts):
-                            merged.counts[i] += c
-                        merged.count += h.count
-                        merged.sum += h.sum
-                        merged.max = max(merged.max, h.max)
-                        merged.min = min(merged.min, h.min)
-                        merged.exemplars.update(h.exemplars)
+                    if h is None:
+                        continue
+                    counts, count, total, mn, mx, exemplars = h._snap()
+                    if not count:
+                        continue
+                    for i, c in enumerate(counts):
+                        merged.counts[i] += c
+                    merged.count += count
+                    merged.sum += total
+                    merged.max = max(merged.max, mx)
+                    merged.min = min(merged.min, mn)
+                    merged.exemplars.update(exemplars)
                 row["service_time_us"] = merged.summary_us()
                 # rates vs the previous snapshot. Mid-chain operators count
                 # batches/bytes, not tuples (per-tuple counts would need a
@@ -493,9 +536,12 @@ class MetricsRegistry:
                         # the RAW settled value (o._last_release_count), not
                         # the settling property: the reporter thread must
                         # neither force a device sync on the driver's async
-                        # counts readback nor race its deferred pool trim
-                        # (settle() is driver-thread-only) — telemetry may
-                        # lag the in-flight push by one
+                        # counts readback nor race its deferred pool trim —
+                        # settle() is restricted to the node's owning
+                        # thread by its `wf-lint: thread-role[driver,
+                        # stage]` annotation (parallel/ordering.py; WF261
+                        # fails the gate if the reporter ever reaches it) —
+                        # telemetry may lag the in-flight push by one
                         "last_release_count": int(o._last_release_count),
                         "mode": o.mode.name,
                     })

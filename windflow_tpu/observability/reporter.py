@@ -54,7 +54,9 @@ class Reporter:
         self.out_dir = out_dir
         self.interval_s = max(0.05, float(interval_s))
         self.prometheus = prometheus
-        self.ticks = 0
+        # bumped by emit(): reporter ticks while running; the driver's final
+        # stop() emit runs only after join() — never two writers at once
+        self.ticks = 0                      # wf-lint: single-writer[reporter]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(out_dir, exist_ok=True)
@@ -64,8 +66,8 @@ class Reporter:
     def start(self) -> None:
         if self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._run, name="wf-reporter",
-                                        daemon=True)
+        self._thread = threading.Thread(  # wf-lint: thread-role[reporter]
+            target=self._run, name="wf-reporter", daemon=True)
         self._thread.start()
 
     def stop(self, final: bool = True) -> None:

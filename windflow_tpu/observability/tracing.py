@@ -166,7 +166,11 @@ def carry(src, dst) -> None:
 # ----------------------------------------------------------- flight recorder
 
 
-class _Segment:
+# the no-lock hot path IS the design: every segment has exactly one writer
+# (its owning thread — driver or a stage body); cross-thread readers
+# (records/abort_open) either hold the registry lock and tolerate a ring
+# slot landing late, or require the owner joined/dead first
+class _Segment:  # wf-lint: single-writer[driver, stage]
     """One thread's pre-allocated slice of the flight recorder.  Single
     writer (the owning thread) — no lock; ``idx`` only grows, slot
     ``idx % capacity`` is overwritten on wrap."""
@@ -287,11 +291,13 @@ class Tracer:
             return sum(s.minted for s in self._segments)
 
     def meta(self) -> dict:
+        with self._seg_lock:            # a stage thread may be registering
+            segs = list(self._segments)  # its segment concurrently
         return {"run_id": self.run_id, "name": self.name,
                 "ids": self.config.ids, "sample_every": self.sample_every,
                 "ring_capacity": self.config.ring_capacity,
                 "minted": self.minted,
-                "dropped": sum(s.dropped for s in self._segments),
+                "dropped": sum(s.dropped for s in segs),
                 "perf_t0": self.perf_t0, "mono_t0": self.mono_t0,
                 "wall_t0": self.wall_t0}
 

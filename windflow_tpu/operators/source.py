@@ -77,7 +77,8 @@ def prefetch_to_device(host_batches: Iterator[Batch], depth: int = 3,
         except BaseException as e:      # noqa: BLE001 — re-raised at consumer
             put_guarded((ERR, e))
 
-    threading.Thread(target=worker, daemon=True, name="wf-prefetch").start()
+    threading.Thread(target=worker, daemon=True,  # wf-lint: thread-role[prefetch]
+                     name="wf-prefetch").start()
     try:
         while True:
             item = q.get()

@@ -308,7 +308,14 @@ def _jitted_cores(mode: ordering_mode_t, merge_impl: str = "xla"):
     return push, first, release
 
 
-class Ordering_Node:
+# every instance is confined to the ONE thread driving it — the pipeline
+# driver, or the owning pipe thread of the threaded graph driver (role
+# stage); the reporter deliberately reads `_last_release_count` raw and
+# never calls into the node (metrics.py).  The WF26x concurrency lint
+# checks this confinement: `settle` is annotated with the allowed roles
+# below, and this class-level single-writer declaration is the recorded
+# rationale for the lock-free mutable fields.
+class Ordering_Node:  # wf-lint: single-writer[driver, stage]
     def __init__(self, n_inputs: int, mode: ordering_mode_t = ordering_mode_t.TS,
                  merge_impl: str = None):
         from ..ops.registry import resolve_impl
@@ -340,17 +347,22 @@ class Ordering_Node:
         (no stale value survives a no-release call)."""
         return self.settle()
 
-    def settle(self) -> int:
+    def settle(self) -> int:  # wf-lint: thread-role[driver, stage]
         """Force the deferred counts readback of the last push/try_release
         (a no-op when none is pending): int() the packed counts, apply the
         owed backlog trim, record ``last_release_count``. Called implicitly
         by the next push/try_release/flush and by the property above — the
         hot path itself never blocks between dispatch and return.
 
-        DRIVER-THREAD ONLY: the check-then-settle is not atomic (the int()
-        blocks on the device and releases the GIL), so a second settling
-        thread could double-apply the pool trim. Off-thread readers
-        (the metrics reporter) read ``_last_release_count`` raw instead."""
+        OWNING-THREAD ONLY — and statically checked: the ``thread-role``
+        annotation above restricts this API to the driver (or the one pipe
+        thread that owns the node in the threaded graph driver); the WF261
+        lint fails the gate if it ever becomes reachable from the reporter,
+        a watchdog, a pool worker, or a JAX callback thread.  The
+        check-then-settle is not atomic (the int() blocks on the device and
+        releases the GIL), so a second settling thread could double-apply
+        the pool trim. Off-thread readers (the metrics reporter) read
+        ``_last_release_count`` raw instead."""
         counts = self._counts_pending
         if counts is not None:
             self._counts_pending = None

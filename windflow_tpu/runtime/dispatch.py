@@ -157,7 +157,13 @@ def build_k_ladder(k_max: int) -> List[int]:
     return sorted(rungs)
 
 
-class MicrobatchAccumulator:
+# each accumulator instance is owned by exactly ONE thread — a driver's
+# ingest loop, or one segment/pipe body of the threaded drivers — and its
+# buffer is plain unlocked state on that basis; the feed/flush surface
+# carries thread-role annotations so WF261 fails the gate if any other
+# role (reporter, watchdog monitor, pool worker, JAX callback) ever
+# reaches a flush path
+class MicrobatchAccumulator:  # wf-lint: single-writer[driver, stage]
     """Gather up to K same-capacity batches into dispatch groups.
 
     ``feed`` returns the groups that became ready (zero, one, or — after a
@@ -165,7 +171,12 @@ class MicrobatchAccumulator:
     + ``take()`` serve the linger path of polling drivers; ``drain()`` the
     EOS / checkpoint-boundary tail; ``clear()`` the supervised restore path
     (replay re-feeds the dropped batches). ``set_k`` actuates an autotuner
-    decision at the next group boundary."""
+    decision at the next group boundary.
+
+    OWNING-THREAD ONLY — statically checked: every group-forming/flushing
+    method below is annotated ``thread-role[driver, stage]`` (the step-
+    timeout watchdog worker counts as the driver: it runs the step on loan
+    while the driver blocks in join, see ``faults.call_with_timeout``)."""
 
     def __init__(self, k: int, linger_s: float = 0.0, clock=time.monotonic,
                  publish_gauge: bool = True):
@@ -197,7 +208,7 @@ class MicrobatchAccumulator:
             _cstate.set_gauge("dispatch_linger_depth", 0)
         return group
 
-    def feed(self, batch) -> List[List]:
+    def feed(self, batch) -> List[List]:  # wf-lint: thread-role[driver, stage]
         """One batch in; the list of groups now ready to dispatch."""
         out: List[List] = []
         if self._buf and self._buf[0].capacity != batch.capacity:
@@ -214,21 +225,21 @@ class MicrobatchAccumulator:
             out.append(self._take())
         return out
 
-    def expired(self) -> bool:
+    def expired(self) -> bool:  # wf-lint: thread-role[driver, stage]
         """True when a partial group has lingered past ``linger_s`` (polling
         drivers dispatch it short rather than hold latency hostage)."""
         return (bool(self._buf) and self._t0 is not None
                 and self.clock() - self._t0 >= self.linger_s)
 
-    def take(self) -> List:
+    def take(self) -> List:  # wf-lint: thread-role[driver, stage]
         """Pop the current partial group (linger flush)."""
         return self._take()
 
-    def drain(self) -> List:
+    def drain(self) -> List:  # wf-lint: thread-role[driver, stage]
         """EOS / checkpoint boundary: the partial tail (< K), possibly []."""
         return self._take() if self._buf else []
 
-    def clear(self) -> None:
+    def clear(self) -> None:  # wf-lint: thread-role[driver, stage]
         """Supervised restore: drop buffered batches — replay from the
         committed position re-feeds them."""
         self._buf = []

@@ -208,9 +208,15 @@ class FaultInjector:
         if spec.kind == "stall":
             time.sleep(spec.stall_s)
             return
+        with self._lock:
+            # under the lock: another stage thread may be bumping this
+            # site's counter concurrently (surfaced by the WF260 lint); the
+            # message's occurrence number may still trail the decision by
+            # design — it is diagnostic text, never replay state
+            occurrence = self.counts[site]
         raise InjectedFault(
             spec.message or f"injected {spec.kind} fault at {site} "
-            f"(occurrence {self.counts[site]}, ctx {ctx})")
+            f"(occurrence {occurrence}, ctx {ctx})")
 
 
 # ------------------------------------------------------------- active injector
@@ -338,7 +344,14 @@ def call_with_timeout(fn, timeout: Optional[float], *, stage: str = "step",
         except BaseException as e:         # noqa: BLE001 — re-raised below
             box["error"] = e
 
-    t = threading.Thread(target=worker, daemon=True,
+    # role DRIVER, not watchdog: the step worker runs the supervised step ON
+    # LOAN from the driver, which blocks in join() below until it finishes
+    # or is abandoned — and an abandoned worker is flagged to never run fn,
+    # then joined with a grace period before any restore (the protocol
+    # callers must follow, see join_abandoned_worker).  Driver-thread-only
+    # APIs (Ordering_Node.settle, TieredTable maintenance) are therefore
+    # legal inside a supervised step.
+    t = threading.Thread(target=worker, daemon=True,  # wf-lint: thread-role[driver]
                          name=f"wf-watchdog-{stage}")
     t.start()
     t.join(timeout)

@@ -73,7 +73,9 @@ class MultiPipe:
     def __init__(self, graph: "PipeGraph", source: Optional[SourceBase] = None):
         self.graph = graph
         self.source = source
-        self.ops: List[Basic_Operator] = []
+        # appended during graph BUILD (driver), before any driver runs;
+        # pipe threads only iterate
+        self.ops: List[Basic_Operator] = []  # wf-lint: single-writer[driver]
         self.sink: Optional[Sink] = None
         self.has_sink = False
         # split structure
@@ -82,7 +84,10 @@ class MultiPipe:
         # merge structure: upstream pipes feeding this one
         self.merge_inputs: List[MultiPipe] = []
         self._dataflow_parent: Optional[MultiPipe] = None   # split-branch feeder
-        self._chain: Optional[CompiledChain] = None
+        # compiled lazily by whichever thread first pushes through this pipe
+        # — the push driver (driver) or the pipe's OWN body thread (stage);
+        # a pipe is never driven from two threads at once
+        self._chain: Optional[CompiledChain] = None  # wf-lint: single-writer[driver, stage]
         self._outputs_to: List[MultiPipe] = []
         self._ordering = None     # lazily-built Ordering_Node (DETERMINISTIC merges)
         # application-tree position of a PARTIAL merge result: the reference
@@ -287,8 +292,9 @@ class PipeGraph:
         self.mode = mode
         #: None = resolve at start(): min withBatch hint over registered
         #: operators (capacity ceilings, wf/builders_gpu.hpp:115-122), else
-        #: DEFAULT_BATCH_SIZE; an explicit value always wins.
-        self.batch_size = batch_size
+        #: DEFAULT_BATCH_SIZE; an explicit value always wins.  Written by
+        #: start() on the driver BEFORE the threaded bodies spawn.
+        self.batch_size = batch_size      # wf-lint: single-writer[driver]
         #: telemetry opt-in (the reference's MONITORING mode): None = consult
         #: WF_MONITORING; True / out-dir string / observability.MonitoringConfig
         #: enable the metrics registry + periodic reporter + event journal +
@@ -302,7 +308,10 @@ class PipeGraph:
         #: driver replays identical ids after a restore.
         self._trace_arg = trace
         self._tracer = None
-        self._trace_labels = None     # id(pipe) -> "pipe<i>" (lazy)
+        # id(pipe) -> "pipe<i>", built lazily by whichever thread first
+        # needs a label; concurrent rebuilds produce the IDENTICAL dict
+        # (pure function of the pipe list), so last-writer-wins is benign
+        self._trace_labels = None     # wf-lint: single-writer[driver, stage]
         #: control-plane opt-in (mirrors monitoring=/faults=): None = consult
         #: WF_CONTROL; resolved at start(). Admission control gates every
         #: source loop; the backpressure governor throttles the threaded
@@ -320,9 +329,11 @@ class PipeGraph:
         #: interleave — downstream split/merge hops stay per-batch, in the
         #: per-batch order.
         self._dispatch_arg = dispatch
-        self._dispatch = None
+        # resolved by start() on the driver before any body thread spawns
+        self._dispatch = None         # wf-lint: single-writer[driver]
         self._e2e_t0 = None           # in-flight e2e latency sample start
-        self._roots: List[MultiPipe] = []
+        # graph build is driver-only; bodies and the reporter only iterate
+        self._roots: List[MultiPipe] = []  # wf-lint: single-writer[driver]
         self._merged_roots: List[MultiPipe] = []
         self._nodes = {}
         self._operators: List[Basic_Operator] = []
@@ -615,11 +626,12 @@ class PipeGraph:
         try:
             threads = []
             for p in pipes:
-                threads.append(threading.Thread(target=pipe_body, args=(p,),
-                                                name=f"wf-pipe-{id(p) % 1000}"))
+                threads.append(threading.Thread(  # wf-lint: thread-role[stage]
+                    target=pipe_body, args=(p,),
+                    name=f"wf-pipe-{id(p) % 1000}"))
             for p in self._roots:
-                threads.append(threading.Thread(target=source_body, args=(p,),
-                                                name="wf-src"))
+                threads.append(threading.Thread(  # wf-lint: thread-role[stage]
+                    target=source_body, args=(p,), name="wf-src"))
             for t in threads:
                 t.start()
             for t in threads:

@@ -90,7 +90,13 @@ def _health_sig(tree) -> str:
     return ";".join(parts)
 
 
-class CompiledChain:
+# a chain instance is driven by exactly ONE thread — the pipeline driver,
+# a segment thread (ThreadedPipeline), or a pipe body (threaded PipeGraph);
+# states/_steps/counters are plain unlocked fields on that basis.  The
+# reporter thread only READS (snapshot-time state readbacks tolerate
+# observing the previous push's list reference — each element is an
+# immutable pytree).  Recorded for the WF260 concurrency lint.
+class CompiledChain:  # wf-lint: single-writer[driver, stage]
     """Compile ``ops`` (no source/sink) into suffix-runnable jitted programs.
 
     ``step_from(i)`` runs ops[i:] — used both for the main path (i=0) and for EOS

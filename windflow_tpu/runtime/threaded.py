@@ -94,7 +94,9 @@ class ThreadedPipeline:
         #: the bounded linger when its input ring runs dry — and runs them as
         #: ONE compiled scan
         self._dispatch_arg = dispatch
-        self._dispatch = None
+        # resolved in run() BEFORE the stage threads start (happens-before
+        # via Thread.start); stages only read
+        self._dispatch = None               # wf-lint: single-writer[driver]
         self.batch_size = batch_size
         self.pin = pin
         self.heartbeat_timeout = heartbeat_timeout
@@ -137,11 +139,19 @@ class ThreadedPipeline:
         #: apply here — each segment chain's capacity is its queue contract.
         from ..control import ControlConfig
         self._control = ControlConfig.resolve(control)
-        self.governor = None
-        self._admission = None
-        self._errors: List[BaseException] = []
-        self._beats = {}                    # stage name -> last heartbeat (monotonic)
-        self._done = set()                  # stages that exited
+        # governor/_admission are built in run() BEFORE the stage threads
+        # start; stage bodies only read the references
+        self.governor = None                # wf-lint: single-writer[driver]
+        self._admission = None              # wf-lint: single-writer[driver]
+        # stage threads append, the driver reads AFTER join() — the join is
+        # the memory barrier, list appends are GIL-atomic
+        self._errors: List[BaseException] = []  # wf-lint: single-writer[stage]
+        # per-stage slot, each written by its own stage thread only; the
+        # watchdog reads and tolerates a stale beat (it re-polls)
+        self._beats = {}                    # wf-lint: single-writer[stage]
+        # set.add per exiting stage; watchdog membership checks are
+        # GIL-atomic and a late observation only delays the stale flag
+        self._done = set()                  # wf-lint: single-writer[stage]
         self.watchdog_stale: List[str] = [] # stages the watchdog flagged
 
     def queue_depths(self) -> dict:
@@ -359,20 +369,22 @@ class ThreadedPipeline:
                     self.governor.stop()
 
     def _run(self):
-        threads = [threading.Thread(target=self._source_body, args=(0,),
-                                    name="wf-source")]
+        threads = [threading.Thread(  # wf-lint: thread-role[stage]
+            target=self._source_body, args=(0,), name="wf-source")]
         for i in range(len(self.chains)):
-            threads.append(threading.Thread(target=self._segment_body,
-                                            args=(i, i + 1), name=f"wf-seg{i}"))
-        threads.append(threading.Thread(target=self._sink_body,
-                                        args=(len(self.chains) + 1,),
-                                        name="wf-sink"))
+            threads.append(threading.Thread(  # wf-lint: thread-role[stage]
+                target=self._segment_body, args=(i, i + 1),
+                name=f"wf-seg{i}"))
+        threads.append(threading.Thread(  # wf-lint: thread-role[stage]
+            target=self._sink_body, args=(len(self.chains) + 1,),
+            name="wf-sink"))
         stop_watchdog = threading.Event()
         watchdog = None
         if self.heartbeat_timeout:
-            watchdog = threading.Thread(target=self._watchdog_body,
-                                        args=(stop_watchdog,), daemon=True,
-                                        name="wf-watchdog")
+            watchdog = threading.Thread(  # wf-lint: thread-role[watchdog]
+                target=self._watchdog_body,
+                args=(stop_watchdog,), daemon=True,
+                name="wf-watchdog")
             watchdog.start()
         for t in threads:
             t.start()

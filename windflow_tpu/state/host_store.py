@@ -32,7 +32,10 @@ Two shapes:
   probeable by later arrivals).
 
 Everything is guarded by one lock (re-admission callbacks run on JAX's
-callback threads while the driver thread settles spills), and the whole
+callback threads while the driver thread settles spills — the static
+concurrency lint infers exactly this split: the ``*_cb`` targets carry the
+``jax-callback`` role, maintain/settle the ``driver``/``stage`` roles, and
+WF260 demands this lock around every field both sides touch), and the whole
 store round-trips through :meth:`manifest`/:meth:`restore` as a dict of
 numpy arrays — it rides the existing checkpoint/exactly-once machinery as
 just more arrays, with per-array checksums for free.

@@ -130,7 +130,12 @@ def _slice_tree(tree, n):
     return jax.tree.map(lambda a: a[:n], tree)
 
 
-class TieredTable:
+# the controller's async-pipeline fields (_cnt/_full/_wm_hint/...) are
+# confined to the ONE thread driving the owning chain (the pipeline driver,
+# or a segment thread of the threaded driver); the JAX callback threads
+# only ever touch the lock-guarded HostStore, never this controller —
+# checked by the thread-role annotations on maintain/settle below
+class TieredTable:  # wf-lint: single-writer[driver, stage]
     """Host-side supervisor of one device table's spill outbox + cold tier.
 
     ``col_keys`` name the outbox fields inside the operator's state dict
@@ -185,11 +190,16 @@ class TieredTable:
 
     # -- the per-push maintenance point -----------------------------------
 
-    def maintain(self, state):
+    def maintain(self, state):  # wf-lint: thread-role[driver, stage]
         """One push boundary: advance the 3-phase async spill pipeline +
         the compaction cadence. Pure host work; the only device interaction
         is starting async copies and (when a prefix settled) one cached
-        clear executable."""
+        clear executable.
+
+        OWNING-THREAD ONLY — statically checked: the ``thread-role``
+        annotation restricts maintenance to the chain's driving thread
+        (driver, or the owning segment thread); WF261 fails the gate if a
+        reporter/watchdog/pool/JAX-callback thread ever reaches it."""
         self._maintains += 1
         if self._full is not None:
             cnt, cols, wm = self._full
@@ -242,11 +252,12 @@ class TieredTable:
                 leaf.copy_to_host_async()
         return (cnt, cols, wm)
 
-    def settle(self, state):
+    def settle(self, state):  # wf-lint: thread-role[driver, stage]
         """Synchronously drain the outbox into the host store (one blocking
         readback) and drop the async pipeline — the pre-snapshot barrier:
         after settle, (state, store) is a consistent pair and nothing is in
-        flight."""
+        flight.  Owning-thread only (the maintain contract, statically
+        checked via the thread-role annotation)."""
         self._cnt = None
         self._full = None
         c0 = int(np.asarray(state[self.count_key]))
@@ -291,8 +302,9 @@ class TieredTable:
 
     def _journal_deltas(self) -> None:
         """Emit ``spill``/``readmit`` journal events for counter movement
-        since the last maintenance point (driver thread only — the
-        callback threads never touch the journal)."""
+        since the last maintenance point.  Runs only under maintain/settle
+        (whose thread-role annotations keep the callback threads out — so
+        the JAX callback threads never touch the journal)."""
         if _journal.get_active() is None:
             return
         cur = self.store.counters()

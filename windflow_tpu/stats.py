@@ -20,7 +20,11 @@ import time
 from .observability.metrics import LogHistogram
 
 
-class Stats_Record:
+# one record per operator replica, bumped only by the thread driving that
+# replica's chain (driver or its owning segment/pipe thread); the reporter
+# reads the plain int counters GIL-atomically and tolerates a one-batch lag
+# (the LogHistogram field locks internally).  Recorded for the WF260 lint.
+class Stats_Record:  # wf-lint: single-writer[driver, stage]
     def __init__(self, op_name: str, replica_id: int = 0):
         self.op_name = op_name
         self.replica_id = replica_id
