@@ -334,6 +334,57 @@ def _shard_recovery_stats(shards: int = 4, total_batches: int = 24,
             "kill_exact": sharded == oracle}
 
 
+def _slo_stats(total_batches: int = 48, batch: int = 4096) -> dict:
+    """Hermetic SLO-engine numbers for the trend (device-free, the
+    ``health``/``shard`` convention): drive a small YSB chain through a
+    monitored run with the default-shaped SLO spec set active at a fast
+    Reporter cadence, and report the worst burn rate + page count off the
+    final snapshot's ``slo`` section — the pages/run column
+    ``bench_trend.py`` renders beside compiles/step.  A healthy engine run
+    pages zero times; a nonzero count here means the default objectives no
+    longer hold on the bench box (a latency/drop regression no throughput
+    row would attribute)."""
+    import json as _json
+    import tempfile
+    from windflow_tpu.benchmarks import ysb
+    from windflow_tpu.observability import MonitoringConfig
+    from windflow_tpu.operators.sink import Sink
+    from windflow_tpu.runtime.pipeline import Pipeline
+
+    panes_per_batch = max(batch // (ysb.EVENTS_PER_TICK * ysb.WIN_LEN), 1) + 1
+    with tempfile.TemporaryDirectory(prefix="wf_bench_slo_") as mon:
+        cfg = MonitoringConfig(out_dir=mon, interval_s=0.05, slo=True,
+                               e2e_sample_every=1)
+        src = ysb.make_source(total=total_batches * batch)
+        ops = ysb.make_ops(pane_capacity=2 * panes_per_batch + 2,
+                           max_wins=panes_per_batch + 64)
+        Pipeline(src, ops, Sink(lambda v: None), batch_size=batch,
+                 monitoring=cfg).run()
+        # worst burn over the WHOLE series, not the final tick: a mid-run
+        # burn that recovered before the run ended would read as ~0 off
+        # snapshot.json alone (pages are cumulative, so the last section
+        # carries the run total)
+        secs = []
+        with open(os.path.join(mon, "snapshots.jsonl")) as f:
+            for line in f:
+                s = _json.loads(line).get("slo")
+                if s:
+                    secs.append(s)
+        if not secs:
+            with open(os.path.join(mon, "snapshot.json")) as f:
+                secs = [_json.load(f).get("slo") or {}]
+    worst = 0.0
+    pages = 0
+    for row in secs[-1].values():
+        pages += int(row.get("pages", 0))
+    for sec in secs:
+        for row in sec.values():
+            worst = max(worst, row.get("burn_fast", 0.0),
+                        row.get("burn_slow", 0.0))
+    return {"slos": len(secs[-1]), "worst_burn": round(worst, 4),
+            "pages": pages}
+
+
 def bench_ysb():
     import jax
     import jax.numpy as jnp
@@ -1325,6 +1376,13 @@ def main():
     except Exception as e:  # noqa: BLE001 — a trend column must never
         #                     block the headline
         print(f"shard recovery stats unavailable: {e}", file=sys.stderr)
+    try:
+        # SLO-engine column (device-free, like `health`): worst burn rate +
+        # page count of the default spec set over a short monitored run
+        headline["slo"] = _slo_stats()
+    except Exception as e:  # noqa: BLE001 — a trend column must never
+        #                     block the headline
+        print(f"slo stats unavailable: {e}", file=sys.stderr)
     record_headline(headline)
     try:
         _secondary_benches(ysb_tps, ysb_step_s, headline)

@@ -73,7 +73,7 @@ def bench_rows(rounds, threshold: float):
                "vs_baseline": None, "stale": False, "status": "",
                "note": "", "flops_per_step": None, "bytes_per_step": None,
                "launches_per_step": None, "compiles_per_step": None,
-               "shard_recovery_ms": None}
+               "shard_recovery_ms": None, "slo_pages": None}
         if parsed is None or rc not in (0, None):
             # rc=1/parsed=null rounds MUST surface — a silent skip would
             # render the failed round as "nothing happened"
@@ -88,6 +88,7 @@ def bench_rows(rounds, threshold: float):
         dispatch = parsed.get("dispatch") or {}
         health = parsed.get("health") or {}
         shard = parsed.get("shard") or {}
+        slo = parsed.get("slo") or {}
         row.update(value=value, unit=parsed.get("unit", ""),
                    vs_baseline=parsed.get("vs_baseline"),
                    stale=bool(parsed.get("stale")),
@@ -112,7 +113,12 @@ def bench_rows(rounds, threshold: float):
                    # rounds like the other hermetic columns (only honest
                    # drills count: a kill that diverged renders "—")
                    shard_recovery_ms=(shard.get("recovery_ms")
-                                      if shard.get("kill_exact") else None))
+                                      if shard.get("kill_exact") else None),
+                   # SLO engine (bench.py headline `slo`): PAGE transitions
+                   # of the default spec set over a short monitored run —
+                   # zero on a healthy box; a nonzero count names a
+                   # latency/drop regression no throughput row attributes
+                   slo_pages=slo.get("pages"))
         if value is None:
             row["status"] = "FAILED"
             row["note"] = "parsed record without a value"
@@ -260,8 +266,8 @@ def render_markdown(bench, multichip, threshold: float,
     lines.append("")
     lines.append("| round | status | value | unit | vs baseline "
                  "| Mflop/step | MB/step | launches/step | compiles/step "
-                 "| shard recov ms | note |")
-    lines.append("|---|---|---|---|---|---|---|---|---|---|---|")
+                 "| pages/run | shard recov ms | note |")
+    lines.append("|---|---|---|---|---|---|---|---|---|---|---|---|")
     for r in bench:
         mflop = (f"{r['flops_per_step'] / 1e6:.2f}"
                  if r.get("flops_per_step") else "—")
@@ -271,15 +277,19 @@ def render_markdown(bench, multichip, threshold: float,
                if r.get("launches_per_step") else "—")
         cps = (f"{r['compiles_per_step']:g}"
                if r.get("compiles_per_step") else "—")
+        # SLO pages/run beside compiles/step: 0 is the healthy reading, so
+        # render a real 0 (None = the round predates the slo block)
+        pg = (f"{r['slo_pages']:g}"
+              if r.get("slo_pages") is not None else "—")
         srm = (f"{r['shard_recovery_ms']:g}"
                if r.get("shard_recovery_ms") is not None else "—")
         lines.append(f"| r{r['round']:02d} | {r['status']} "
                      f"| {_fmt(r['value'])} | {r['unit'] or '—'} "
                      f"| {_fmt(r['vs_baseline'])} "
-                     f"| {mflop} | {mb} | {lps} | {cps} | {srm} "
+                     f"| {mflop} | {mb} | {lps} | {cps} | {pg} | {srm} "
                      f"| {_cell(r['note'] or '')} |")
     if not bench:
-        lines.append("| — | — | — | — | — | — | — | — | — | — "
+        lines.append("| — | — | — | — | — | — | — | — | — | — | — "
                      "| no BENCH_r*.json found |")
     if nexmark is not None:
         lines += render_nexmark(*nexmark)

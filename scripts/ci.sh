@@ -49,6 +49,75 @@ run_step() {
 run_step "wf_lint" python scripts/wf_lint.py
 run_step "perf gate" env JAX_PLATFORMS=cpu python scripts/wf_perfgate.py
 
+# stdlib-CLI exit-code contracts under a poisoned-jax PYTHONPATH: every
+# artifact CLI must run on a box without JAX (they load the observability
+# helpers by file path), and wf_slo.py must additionally honor its
+# 0 = ok / 1 = burning / 2 = unusable-inputs contract over a synthetic
+# snapshots.jsonl.  Kept in one bash -c step so the temp tree and the
+# poisoned jax module never leak into the later pytest step.
+stdlib_cli_contracts() {
+    local tmp rc
+    tmp=$(mktemp -d) || return 1
+    printf 'raise ImportError("stdlib CLIs must not import jax")\n' \
+        > "$tmp/jax.py"
+    # missing inputs -> exit 2, for every artifact CLI (wf_trace keys its
+    # inputs off --trace-dir rather than --monitoring-dir)
+    local cli dirflag
+    for cli in wf_slo wf_state wf_health wf_trace; do
+        dirflag="--monitoring-dir"
+        [ "$cli" = "wf_trace" ] && dirflag="--trace-dir"
+        PYTHONPATH="$tmp" python "scripts/${cli}.py" \
+            "$dirflag" "$tmp/nope" >/dev/null 2>&1
+        rc=$?
+        if [ "$rc" -ne 2 ]; then
+            echo "ci: ${cli}.py missing-inputs contract broke (rc=${rc}," \
+                 "want 2)" >&2
+            rm -rf "$tmp"; return 1
+        fi
+    done
+    # wf_slo burn contract: a series violating the latency target on every
+    # tick must exit 1; a recovered tail must exit 0
+    python - "$tmp" <<'PY'
+import json, os, sys
+tmp = sys.argv[1]
+def snap(p99):
+    return {"graph": "ci", "operators": [],
+            "e2e_latency_us": {"p99": p99 * 1e3, "p99_tick": p99 * 1e3,
+                               "samples": 8, "samples_tick": 8}}
+burn = [snap(50.0) for _ in range(8)]
+ok = burn + [snap(0.5) for _ in range(8)]
+for name, series in (("burning", burn), ("recovered", ok)):
+    d = os.path.join(tmp, name); os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "snapshots.jsonl"), "w") as f:
+        for s in series:
+            f.write(json.dumps(s) + "\n")
+spec = [{"name": "lat", "signal": "e2e_p99_ms", "target": 10.0,
+         "objective": 0.5, "fast_window": 2, "slow_window": 4}]
+with open(os.path.join(tmp, "spec.json"), "w") as f:
+    json.dump(spec, f)
+PY
+    PYTHONPATH="$tmp" python scripts/wf_slo.py \
+        --monitoring-dir "$tmp/burning" --specs "$tmp/spec.json" \
+        >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 1 ]; then
+        echo "ci: wf_slo.py burning contract broke (rc=${rc}, want 1)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_slo.py \
+        --monitoring-dir "$tmp/recovered" --specs "$tmp/spec.json" \
+        >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_slo.py recovered contract broke (rc=${rc}, want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    rm -rf "$tmp"
+    echo "stdlib CLI exit contracts ok (wf_slo 0/1/2, wf_state/wf_health/"
+    echo "wf_trace 2 on missing inputs; all without jax)"
+}
+run_step "stdlib CLIs" stdlib_cli_contracts
+
 if [ "${1:-}" != "--fast" ]; then
     # the ROADMAP.md tier-1 verify command (minus the log plumbing)
     run_step "tier-1 tests" env JAX_PLATFORMS=cpu \

@@ -48,15 +48,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _load_device_health():
     """Load observability/device_health.py (and the journal module its
-    relative import names) by file path under a synthetic package — no
-    windflow_tpu package import, no JAX."""
+    relative import names, plus slo.py for the incident-bundle readers) by
+    file path under a synthetic package — no windflow_tpu package import,
+    no JAX."""
     obs = os.path.join(REPO, "windflow_tpu", "observability")
     pkg = sys.modules.get("wf_obs")
     if pkg is None:
         pkg = types.ModuleType("wf_obs")
         pkg.__path__ = [obs]
         sys.modules["wf_obs"] = pkg
-    for name in ("journal", "device_health"):
+    for name in ("journal", "device_health", "slo"):
         if f"wf_obs.{name}" in sys.modules:
             continue
         spec = importlib.util.spec_from_file_location(
@@ -65,7 +66,7 @@ def _load_device_health():
         sys.modules[f"wf_obs.{name}"] = mod
         spec.loader.exec_module(mod)
         setattr(pkg, name, mod)
-    return sys.modules["wf_obs.device_health"]
+    return sys.modules["wf_obs.device_health"], sys.modules["wf_obs.slo"]
 
 
 def _fmt_bytes(n):
@@ -284,6 +285,28 @@ def shard_report(snap, journal):
     return lines
 
 
+def incidents_report(slo_mod, mon_dir):
+    """Cross-reference to the SLO engine's forensic bundles (count, last
+    incident path + triggering SLO, torn captures) — read from the bundle
+    manifests under ``<mon_dir>/incidents`` (``slo.incidents_summary``)."""
+    lines = ["== incidents (SLO forensic bundles) =="]
+    summ = slo_mod.incidents_summary(mon_dir)
+    if not summ["count"] and not summ["torn"]:
+        lines.append("  (none captured — enable with WF_SLO=1 / "
+                     "MonitoringConfig(slo=...); analyze with "
+                     "scripts/wf_slo.py)")
+        return lines
+    lines.append(f"  {summ['count']} committed bundle(s)"
+                 + (f", {summ['torn']} TORN (crash mid-capture)"
+                    if summ["torn"] else ""))
+    last = summ.get("last")
+    if last:
+        lines.append(f"  last: {last['path']}")
+        lines.append(f"        triggered by SLO {last.get('slo')!r} "
+                     f"(state {last.get('state')})")
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="wf_health",
@@ -298,7 +321,8 @@ def main(argv=None) -> int:
                          "snapshots.jsonl paths) into one fleet view "
                          "instead of reading --monitoring-dir")
     ap.add_argument("--report", choices=("all", "memory", "compile",
-                                         "device-time", "shards"),
+                                         "device-time", "shards",
+                                         "incidents"),
                     default="all",
                     help="which section(s) to render (default all)")
     ap.add_argument("--json", action="store_true",
@@ -307,7 +331,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        dh = _load_device_health()
+        dh, slo_mod = _load_device_health()
     except (OSError, ImportError, SyntaxError) as e:
         print(f"wf_health: cannot load observability/device_health.py from "
               f"{REPO!r}: {type(e).__name__}: {e}\n"
@@ -336,6 +360,8 @@ def main(argv=None) -> int:
                "shards": snap.get("shards") or {},
                "snapshots": len(series),
                "journal_events": len(journal)}
+        if not args.merge:
+            out["incidents"] = slo_mod.incidents_summary(args.monitoring_dir)
         if snap.get("hosts"):
             out["hosts"] = snap["hosts"]
             out["merged_from"] = snap.get("merged_from")
@@ -357,6 +383,21 @@ def main(argv=None) -> int:
     if args.report == "shards" or (args.report == "all"
                                    and snap.get("shards")):
         blocks.append(shard_report(snap, journal))
+    if args.report in ("all", "incidents"):
+        if args.merge:
+            # per-host forensics: a merged fleet view has no single
+            # incidents/ directory — say so when incidents were asked for
+            # explicitly instead of rendering nothing (indistinguishable
+            # from "no incidents on the fleet")
+            if args.report == "incidents":
+                blocks.append(
+                    ["== incidents (SLO forensic bundles) ==",
+                     "  (not available in the --merge fleet view — "
+                     "bundles live under each host's own "
+                     "<monitoring_dir>/incidents/; run wf_health "
+                     "against each host's dir)"])
+        else:
+            blocks.append(incidents_report(slo_mod, args.monitoring_dir))
     for b in blocks:
         print()
         print("\n".join(b))

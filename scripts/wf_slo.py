@@ -1,0 +1,270 @@
+#!/usr/bin/env python3
+"""wf_slo — SLO burn-rate / health-state / incident-forensics CLI.
+
+Evaluates a declarative SLO spec set offline over any monitoring run's
+``snapshots.jsonl`` (the exact burn/state math the live Reporter-tick engine
+runs — ``observability/slo.py::evaluate_series``) and renders:
+
+- the **burn-rate table**: per SLO, the latest signal value vs target, the
+  fast/slow window burn rates, the health state, and the page count;
+- the **state timeline**: every OK -> WARN -> PAGE -> OK transition with its
+  tick — the incident's shape at a glance;
+- the **incident ledger**: committed forensic bundles under
+  ``<dir>/incidents/`` (triggering SLO, captured files, validation against
+  each bundle's manifest), with manifest-less directories reported as TORN
+  (a crash mid-capture — the manifest is the commit point, so a torn bundle
+  never half-parses);
+- any SLO sections the snapshots RECORDED live (the engine's own verdicts,
+  when the run had ``slo=`` on).
+
+Spec source precedence: ``--specs`` (JSON file path or inline JSON) >
+``WF_SLO`` env (same forms) > the built-in default spec set.
+
+Produce the inputs with::
+
+    WF_MONITORING=1 WF_SLO=1 python my_run.py
+    python scripts/wf_slo.py --monitoring-dir wf_monitoring
+
+Stdlib only (``observability/slo.py`` + ``device_health.py`` + ``journal.py``
+are loaded by file path — the ``wf_state.py`` convention), so this works on
+any box the artifacts were copied to, without JAX installed.
+
+Exit codes: 0 = no SLO burning (every final state OK), 1 = at least one SLO
+burning in the evaluated window, 2 = missing/unreadable inputs or usage
+error (``tests/test_slo.py`` pins the contract).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs(names=("journal", "device_health", "slo")):
+    """Load the observability helper modules by file path under a synthetic
+    package — no windflow_tpu package import, no JAX (the wf_health.py
+    loader, grown the slo module)."""
+    obs = os.path.join(REPO, "windflow_tpu", "observability")
+    pkg = sys.modules.get("wf_obs")
+    if pkg is None:
+        pkg = types.ModuleType("wf_obs")
+        pkg.__path__ = [obs]
+        sys.modules["wf_obs"] = pkg
+    for name in names:
+        if f"wf_obs.{name}" in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            f"wf_obs.{name}", os.path.join(obs, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"wf_obs.{name}"] = mod
+        spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return sys.modules["wf_obs.device_health"], sys.modules["wf_obs.slo"]
+
+
+# ------------------------------------------------------------ report pieces
+
+
+def burn_table(report):
+    lines = ["== SLO burn rates =="]
+    if not report:
+        lines.append("  (no SLOs evaluated)")
+        return lines
+    lines.append(f"  {'slo':<16} {'signal':<16} {'value':>12} {'target':>10} "
+                 f"{'burn_fast':>9} {'burn_slow':>9} {'state':>6} "
+                 f"{'pages':>5}")
+    for name in sorted(report):
+        row = report[name]
+        v = row.get("signal")
+        flag = ""
+        if row.get("state") == "page":
+            flag = "  [PAGE]"
+        elif row.get("state") == "warn":
+            flag = "  [WARN]"
+        lines.append(
+            f"  {name:<16} {row.get('signal_name', '?'):<16} "
+            f"{(f'{v:g}' if v is not None else '—'):>12} "
+            f"{row.get('target', 0):>10g} {row.get('burn_fast', 0):>9g} "
+            f"{row.get('burn_slow', 0):>9g} {row.get('state', '?'):>6} "
+            f"{row.get('pages', 0):>5}{flag}")
+    return lines
+
+
+def timeline(report):
+    lines = ["== state timeline =="]
+    any_tr = False
+    for name in sorted(report):
+        for tr in report[name].get("transitions", []):
+            any_tr = True
+            lines.append(f"  tick {tr['tick']:>5}  {name:<16} "
+                         f"{tr['from']} -> {tr['to']}")
+    if not any_tr:
+        lines.append("  (no transitions — every SLO stayed OK over the "
+                     "evaluated window)")
+    return lines
+
+
+def recorded_section(series):
+    """The live engine's own verdicts, when the run recorded them."""
+    last = next((s.get("slo") for s in reversed(series) if s.get("slo")),
+                None)
+    if not last:
+        return None
+    lines = ["== recorded live verdicts (snapshot 'slo' sections) =="]
+    for name in sorted(last):
+        row = last[name]
+        lines.append(f"  {name:<16} state={row.get('state', '?'):<5} "
+                     f"burn_fast={row.get('burn_fast', 0):g} "
+                     f"burn_slow={row.get('burn_slow', 0):g} "
+                     f"pages={row.get('pages', 0)}")
+    return lines
+
+
+def incidents_section(slo_mod, mon_dir):
+    lines = ["== incident bundles =="]
+    bundles, torn = slo_mod.list_incidents(mon_dir)
+    if not bundles and not torn:
+        lines.append("  (none captured)")
+        return lines
+    for man in bundles:
+        miss = (f"  MISSING: {', '.join(man['missing'])}"
+                if man.get("missing") else "")
+        lines.append(
+            f"  {os.path.basename(man['path']):<40} slo={man.get('slo')} "
+            f"tick={man.get('tick')} files={len(man.get('files', []))}"
+            f"{miss}")
+    for name in torn:
+        lines.append(f"  {name:<40} TORN (no committed manifest — crash "
+                     f"mid-capture)")
+    return lines
+
+
+def _resolve_specs(slo_mod, specs_arg):
+    if specs_arg:
+        return slo_mod.resolve_specs(specs_arg)
+    env = os.environ.get("WF_SLO", "")
+    if env not in ("", "0"):
+        return slo_mod.resolve_specs(env)
+    return slo_mod.default_specs()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_slo",
+        description="windflow_tpu SLO CLI (burn-rate tables, state "
+                    "timeline, incident bundles; exit 1 = burning)")
+    ap.add_argument("--monitoring-dir", default="wf_monitoring",
+                    help="monitoring output directory (snapshots.jsonl + "
+                         "snapshot.json + events.jsonl + incidents/)")
+    ap.add_argument("--specs", default=None, metavar="JSON",
+                    help="SLO spec set: a JSON file path or inline JSON "
+                         "(list of {name,signal,target,...}); default: "
+                         "WF_SLO env, else the built-in default set")
+    ap.add_argument("--report", choices=("all", "burn", "timeline",
+                                         "incidents"), default="all",
+                    help="which section(s) to render (default all)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: the evaluation report + "
+                         "incident ledger + recorded live sections")
+    args = ap.parse_args(argv)
+
+    try:
+        dh, slo_mod = _load_obs()
+    except (OSError, ImportError, SyntaxError) as e:
+        print(f"wf_slo: cannot load observability helpers from {REPO!r}: "
+              f"{type(e).__name__}: {e}\n"
+              f"(keep scripts/wf_slo.py next to its windflow_tpu tree — it "
+              f"reuses the burn math and bundle readers by file path)",
+              file=sys.stderr)
+        return 2
+    try:
+        specs = _resolve_specs(slo_mod, args.specs)
+    except (OSError, ValueError, TypeError) as e:
+        print(f"wf_slo: cannot resolve the SLO spec set: "
+              f"{type(e).__name__}: {e}\n"
+              f"(--specs/WF_SLO take a JSON file path or inline JSON — a "
+              f"list of spec objects or {{'specs': [...]}}; the validator "
+              f"reports the same problems as WF116)", file=sys.stderr)
+        return 2
+    if not specs:
+        # resolve_specs maps '[]'/'{"specs": []}' to an empty set — there
+        # is nothing to evaluate, which is unusable input (2), NOT
+        # "burning" (1): an automation caller must never read an empty
+        # spec file as an active incident
+        print("wf_slo: the resolved SLO spec set is empty — nothing to "
+              "evaluate\n(--specs/WF_SLO need at least one "
+              "{name,signal,target,...} object; omit both for the "
+              "built-in default set)", file=sys.stderr)
+        return 2
+    problems = [f"{s.name}: {p}" for s in specs
+                for p in slo_mod.spec_problems(s)]
+    seen = set()
+    for s in specs:
+        # duplicate names are an engine-constructor error (the report keys
+        # rows by name) — catch them HERE so a spec typo exits 2, never the
+        # burning code 1
+        if s.name in seen:
+            problems.append(f"{s.name}: duplicate SLO name")
+        seen.add(s.name)
+    if problems:
+        print("wf_slo: invalid SLO spec set (WF116):\n  "
+              + "\n  ".join(problems), file=sys.stderr)
+        return 2
+    try:
+        _latest, series = dh.load_snapshots(args.monitoring_dir)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"wf_slo: cannot load snapshots from "
+              f"{args.monitoring_dir!r}: {type(e).__name__}: {e}\n"
+              f"(run with WF_MONITORING=1 — add WF_SLO=1 for live "
+              f"evaluation + incident capture)", file=sys.stderr)
+        return 2
+    if not series:
+        series = [_latest]
+
+    report = slo_mod.evaluate_series(specs, series)
+    burning = slo_mod.burning(report)
+    bundles, torn = slo_mod.list_incidents(args.monitoring_dir)
+
+    if args.json:
+        print(json.dumps({
+            "monitoring_dir": args.monitoring_dir,
+            "snapshots": len(series),
+            "specs": [{"name": s.name, "signal": s.signal,
+                       "target": s.target, "objective": s.objective,
+                       "fast_window": s.fast_window,
+                       "slow_window": s.slow_window,
+                       "warn_burn": s.warn_burn, "page_burn": s.page_burn,
+                       "mode": s.resolved_mode()} for s in specs],
+            "report": report,
+            "burning": burning,
+            "incidents": bundles,
+            "incidents_torn": torn,
+        }, indent=1, sort_keys=True, default=str))
+        return 1 if burning else 0
+
+    print(f"wf_slo: {args.monitoring_dir!r} — {len(series)} snapshot(s), "
+          f"{len(specs)} SLO spec(s)"
+          + (f", BURNING: {', '.join(burning)}" if burning
+             else ", all OK"))
+    blocks = []
+    if args.report in ("all", "burn"):
+        blocks.append(burn_table(report))
+    if args.report in ("all", "timeline"):
+        blocks.append(timeline(report))
+        rec = recorded_section(series)
+        if args.report == "all" and rec:
+            blocks.append(rec)
+    if args.report in ("all", "incidents"):
+        blocks.append(incidents_section(slo_mod, args.monitoring_dir))
+    for b in blocks:
+        print()
+        print("\n".join(b))
+    return 1 if burning else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

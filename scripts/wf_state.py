@@ -59,7 +59,7 @@ def _load_device_health():
         pkg = types.ModuleType("wf_obs")
         pkg.__path__ = [obs]
         sys.modules["wf_obs"] = pkg
-    for name in ("journal", "device_health"):
+    for name in ("journal", "device_health", "slo"):
         if f"wf_obs.{name}" in sys.modules:
             continue
         spec = importlib.util.spec_from_file_location(
@@ -68,7 +68,7 @@ def _load_device_health():
         sys.modules[f"wf_obs.{name}"] = mod
         spec.loader.exec_module(mod)
         setattr(pkg, name, mod)
-    return sys.modules["wf_obs.device_health"]
+    return sys.modules["wf_obs.device_health"], sys.modules["wf_obs.slo"]
 
 
 # ------------------------------------------------------------ report pieces
@@ -283,6 +283,29 @@ def shard_section(snap, journal):
     return lines
 
 
+def incidents_section(slo_mod, mon_dir):
+    """Cross-reference to the SLO engine's forensic bundles (count, last
+    incident path + triggering SLO, torn captures) read from the bundle
+    manifests under ``<mon_dir>/incidents`` — the wf_health.py section,
+    mirrored here so the state inspector names the forensics too."""
+    lines = ["== incidents (SLO forensic bundles) =="]
+    summ = slo_mod.incidents_summary(mon_dir)
+    if not summ["count"] and not summ["torn"]:
+        lines.append("  (none captured — enable with WF_SLO=1 / "
+                     "MonitoringConfig(slo=...); analyze with "
+                     "scripts/wf_slo.py)")
+        return lines
+    lines.append(f"  {summ['count']} committed bundle(s)"
+                 + (f", {summ['torn']} TORN (crash mid-capture)"
+                    if summ["torn"] else ""))
+    last = summ.get("last")
+    if last:
+        lines.append(f"  last: {last['path']}")
+        lines.append(f"        triggered by SLO {last.get('slo')!r} "
+                     f"(state {last.get('state')})")
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="wf_state",
@@ -304,7 +327,8 @@ def main(argv=None) -> int:
                     help=f"occupancy percentage flagged [OVERFLOW-RISK] in "
                          f"the pressure/tier reports (default {RISK_PCT})")
     ap.add_argument("--report", choices=("all", "watermarks", "pressure",
-                                         "tier", "lateness", "shards"),
+                                         "tier", "lateness", "shards",
+                                         "incidents"),
                     default="all",
                     help="which section(s) to render (default all)")
     ap.add_argument("--json", action="store_true",
@@ -323,7 +347,7 @@ def main(argv=None) -> int:
         return 2
     try:
         et = _load_event_time()
-        dh = _load_device_health()
+        dh, slo_mod = _load_device_health()
     except (OSError, ImportError, SyntaxError) as e:
         # the 0/2 contract covers the helper modules too: a box the
         # artifacts were copied to without the windflow_tpu tree beside
@@ -361,6 +385,8 @@ def main(argv=None) -> int:
                         if isinstance(sec.get("tier"), dict)},
                "shards": snap.get("shards") or {},
                "snapshots": len(series)}
+        if not args.merge:
+            out["incidents"] = slo_mod.incidents_summary(args.monitoring_dir)
         if snap.get("hosts"):
             out["hosts"] = snap["hosts"]
             out["merged_from"] = snap.get("merged_from")
@@ -378,6 +404,21 @@ def main(argv=None) -> int:
     if args.report == "shards" or (args.report == "all"
                                    and snap.get("shards")):
         blocks.append(shard_section(snap, journal))
+    if args.report in ("all", "incidents"):
+        if args.merge:
+            # per-host forensics: a merged fleet view has no single
+            # incidents/ directory — say so when incidents were asked for
+            # explicitly instead of rendering nothing (indistinguishable
+            # from "no incidents on the fleet")
+            if args.report == "incidents":
+                blocks.append(
+                    ["== incidents (SLO forensic bundles) ==",
+                     "  (not available in the --merge fleet view — "
+                     "bundles live under each host's own "
+                     "<monitoring_dir>/incidents/; run wf_state "
+                     "against each host's dir)"])
+        else:
+            blocks.append(incidents_section(slo_mod, args.monitoring_dir))
     head = (f"wf_state: merged {snap.get('merged_from')} host(s): "
             + ", ".join(h.get("host", "?") for h in snap.get("hosts", []))
             if args.merge else f"wf_state: {args.monitoring_dir!r}")

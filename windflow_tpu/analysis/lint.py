@@ -8,6 +8,17 @@ enforces the codebase invariants that PRs 1-3 established by convention:
 ====== ========= =====================================================
 code   severity  invariant
 ====== ========= =====================================================
+WF116  error     SLO config the run cannot honor (a validate()-time
+                 code, registered in RULES for --explain/--select):
+                 ``WF_SLO`` set while monitoring itself resolves off
+                 (the engine could never evaluate), a spec set that
+                 does not resolve (malformed JSON / unreadable file /
+                 unknown field), an unknown signal name (see
+                 ``observability/slo.py::SIGNALS``), or burn-window
+                 geometry the math rejects (``fast_window >=
+                 slow_window``, objective outside (0, 1),
+                 ``warn_burn > page_burn``) — fix hints name the
+                 registered signals and the window contract
 WF200  error     scanned file fails to parse (the linter cannot see it)
 WF201  error     ``WF_*`` env read missing from ``docs/ENV_FLAGS.md``
 WF202  error     ENV_FLAGS.md row does not state WHEN the flag is read
@@ -77,6 +88,12 @@ SEVERITIES = ("error", "warning")
 #: text can never drift from the registered codes.  Values:
 #: ``(severity, one-line summary)``.
 RULES: Dict[str, Tuple[str, str]] = {
+    # WF116 is a validate()-time code (analysis/validate.py::_check_slo),
+    # registered here so --explain/--select know it — the linter itself
+    # never emits it (pre-run config legality needs the live env/config)
+    "WF116": ("error", "SLO config the run cannot honor (WF_SLO while "
+                       "monitoring off, malformed spec set, unknown "
+                       "signal name, fast_window >= slow_window)"),
     "WF200": ("error", "scanned file fails to parse (the linter cannot "
                        "see it)"),
     "WF201": ("error", "WF_* env read missing from docs/ENV_FLAGS.md"),

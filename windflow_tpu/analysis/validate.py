@@ -69,6 +69,15 @@ WF113  error     runtime-health config the run cannot honor: the
                  never activate — the run would silently produce no
                  health artifacts), or an illegal
                  ``WF_HEALTH_SAMPLE`` (non-integer / < 1)
+WF116  error     SLO config the run cannot honor
+                 (``observability/slo.py``): the ``WF_SLO`` sub-toggle
+                 set while monitoring itself resolves off (the engine
+                 could never evaluate — no burn-rate alerting, no
+                 incident capture), a spec set that does not resolve
+                 (malformed JSON / unreadable file / bad field), an
+                 unknown signal name, or per-spec geometry the burn
+                 math rejects (``fast_window >= slow_window``,
+                 objective outside (0, 1), ``warn_burn > page_burn``)
 WF114  warn/err  tiered keyed state (``windflow_tpu/state``) combined
                  with a configuration its determinism/sizing contract
                  cannot honor: sequence-id tracing or wall-clock
@@ -645,6 +654,61 @@ def _check_health(report, stored_monitoring) -> None:
                      "health=True) on the driver)")
 
 
+def _check_slo(report, stored_monitoring) -> None:
+    """WF116: the SLO mirror of WF113 — resolve the monitoring config
+    exactly as the Monitor will and reject SLO configurations the engine
+    cannot honor before the run starts (the engine itself raises the same
+    problems at Monitor construction; this surfaces them pre-run with the
+    operator-path/hint shape)."""
+    import os
+    from ..observability import MonitoringConfig
+    from ..observability import slo as _slo
+    try:
+        cfg = MonitoringConfig.resolve(stored_monitoring)
+    except (ValueError, TypeError):
+        return                          # already diagnosed as WF113
+    if cfg is None:
+        env = os.environ.get("WF_SLO", "")
+        if env not in ("", "0"):
+            report.add(
+                "WF116", "error", "monitoring.slo",
+                "WF_SLO is set but monitoring itself resolves off — the "
+                "SLO engine can never evaluate, so burn-rate alerting and "
+                "incident capture are silently disabled",
+                hint="enable monitoring alongside the sub-toggle: "
+                     "WF_MONITORING=1 (or monitoring=/MonitoringConfig("
+                     "slo=...) on the driver)")
+        return
+    try:
+        specs = _slo.resolve_specs(cfg.slo)
+    except (ValueError, TypeError, OSError) as e:
+        report.add(
+            "WF116", "error", "monitoring.slo",
+            f"SLO spec set does not resolve: {type(e).__name__}: {e}",
+            hint="slo=/WF_SLO accept True/'1' (default specs), a list of "
+                 "slo.SLOSpec/dicts, or a JSON file path / inline JSON "
+                 "(a list of {name,signal,target,...} objects)")
+        return
+    if not specs:
+        return
+    seen = set()
+    for spec in specs:
+        where = f"slo[{spec.name}]"
+        for prob in _slo.spec_problems(spec):
+            report.add(
+                "WF116", "error", where, prob,
+                hint=f"registered signals: {', '.join(sorted(_slo.SIGNALS))}"
+                     f"; the burn windows are Reporter ticks — the fast "
+                     f"window detects the spike, the slow one confirms the "
+                     f"sustained burn (fast < slow)")
+        if spec.name in seen:
+            report.add("WF116", "error", where,
+                       "duplicate SLO name — the snapshot/Prometheus "
+                       "surface keys per-SLO rows by name",
+                       hint="give every SLOSpec a unique name")
+        seen.add(spec.name)
+
+
 def _check_kernel_records(report) -> None:
     """WF109: compare every kernel-impl choice the registry recorded at
     trace time against what it would resolve to NOW (env/tuning-cache as of
@@ -1009,6 +1073,7 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(p, "_trace_arg", None), supervised)
     _check_health(report, getattr(p, "_monitoring_arg", None))
+    _check_slo(report, getattr(p, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(p, "_dispatch_arg", None), cfg,
                     trace, getattr(p, "_trace_arg", None), supervised)
 
@@ -1032,6 +1097,7 @@ def _validate_supervised(report, sp, faults, control, trace=None,
     _check_admission(report, cfg, True, "control.admission")
     _check_trace(report, trace, getattr(sp, "_trace_arg", None), True)
     _check_health(report, getattr(sp, "_monitoring_arg", None))
+    _check_slo(report, getattr(sp, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(sp, "_dispatch_arg", None),
                     cfg, trace, getattr(sp, "_trace_arg", None), True)
     _check_shards(report,
@@ -1085,6 +1151,7 @@ def _validate_threaded(report, tp, faults, control, supervised,
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(tp, "_trace_arg", None), supervised)
     _check_health(report, getattr(tp, "_monitoring_arg", None))
+    _check_slo(report, getattr(tp, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(tp, "_dispatch_arg", None),
                     cfg, trace, getattr(tp, "_trace_arg", None), supervised,
                     edges=edges)
@@ -1196,6 +1263,7 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_admission(report, cfg, supervised, "control.admission")
     _check_trace(report, trace, getattr(g, "_trace_arg", None), supervised)
     _check_health(report, getattr(g, "_monitoring_arg", None))
+    _check_slo(report, getattr(g, "_monitoring_arg", None))
     dedges = None
     if threaded:
         try:

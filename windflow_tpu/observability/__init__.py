@@ -26,6 +26,11 @@ enabled per graph/pipeline via ``PipeGraph(..., monitoring=...)`` /
     WF_MONITORING_EVENT_TIME=1   # event-time sub-toggle (watermark map +
                                  # on-device lateness histograms; see
                                  # MonitoringConfig.event_time)
+    WF_SLO=1                     # SLO-engine sub-toggle (burn-rate alerting
+                                 # + incident bundles; '1' = default specs,
+                                 # else JSON path/inline; see
+                                 # MonitoringConfig.slo + slo.py)
+    WF_SNAPSHOT_KEEP=500         # snapshots.jsonl keep-last-N retention
 """
 
 from __future__ import annotations
@@ -38,6 +43,7 @@ from .journal import EventJournal, read_journal, set_active as set_journal
 from .metrics import LogHistogram, MetricsRegistry
 from . import device_health
 from . import event_time
+from . import slo as slo_engine
 from .names import (CONTROL_COUNTERS, CONTROL_GAUGES, JOURNAL_EVENTS,
                     RECOVERY_COUNTERS, TRACE_RECORD_KINDS, TRACE_STAGES)
 from .reporter import Reporter
@@ -51,7 +57,7 @@ __all__ = [
     "LogHistogram", "MetricsRegistry", "Reporter", "EventJournal",
     "MonitoringConfig", "Monitor", "journal", "read_journal", "set_journal",
     "TraceConfig", "Tracer", "tracing", "event_time", "event_time_enabled",
-    "device_health",
+    "device_health", "slo_engine",
     "topology_dot", "topology_json", "graph_topology_dot",
     "graph_topology_json", "pipeline_topology_dot", "pipeline_topology_json",
 ]
@@ -113,6 +119,29 @@ class MonitoringConfig:
     #: compile-heavy monitored runs (capacity/K ladders, autotune sweeps)
     #: where the cause/key/duration columns are enough
     health_cost_analysis: bool = True
+    #: SLO sub-toggle (off by default): a declarative objective set over
+    #: signals the snapshots already carry, evaluated as a per-SLO
+    #: OK->WARN->PAGE state machine with fast/slow multi-window burn rates
+    #: INSIDE every Reporter tick, plus automatic rate-limited incident
+    #: bundles on PAGE (``observability/slo.py``).  Accepts ``True``
+    #: (default spec set), a list of ``slo.SLOSpec``/dicts, or a JSON file
+    #: path / inline JSON.  Host-side Reporter-thread work ONLY — compiled
+    #: programs, operator state, and the perf-gate pins are byte-for-byte
+    #: unchanged either way.  Env override: ``WF_SLO`` (``''``/``'0'`` off,
+    #: ``'1'`` defaults, anything else a spec path / inline JSON); analyze
+    #: with ``scripts/wf_slo.py``.
+    slo: object = False
+    #: minimum seconds between incident bundles + hard cap per run — the
+    #: rate limit that keeps a restart storm from burying the host under
+    #: forensics (``WF_SLO_COOLDOWN_S`` / ``WF_SLO_MAX_INCIDENTS``)
+    slo_cooldown_s: float = 60.0
+    slo_max_incidents: int = 8
+    #: keep-last-N-lines retention for snapshots.jsonl (None = unlimited,
+    #: today's behavior) — a long-running service's time series must not
+    #: grow without bound; rotation is an atomic rewrite on the Reporter
+    #: thread.  Env override: ``WF_SNAPSHOT_KEEP`` (``''``/``'0'`` =
+    #: unlimited).
+    snapshot_keep: Optional[int] = None
 
     def should_sample_e2e(self, n: int) -> bool:
         """THE e2e sampling policy, shared by every driver: every Nth source
@@ -154,6 +183,25 @@ class MonitoringConfig:
         hs = os.environ.get("WF_HEALTH_SAMPLE", "")
         if hs:
             cfg = dataclasses.replace(cfg, health_sample=int(hs))
+        sv = os.environ.get("WF_SLO")
+        if sv is not None and sv != "":
+            cfg = dataclasses.replace(
+                cfg, slo=(False if sv == "0"
+                          else (True if sv == "1" else sv)))
+        sc = os.environ.get("WF_SLO_COOLDOWN_S", "")
+        if sc:
+            cfg = dataclasses.replace(cfg, slo_cooldown_s=float(sc))
+        sm = os.environ.get("WF_SLO_MAX_INCIDENTS", "")
+        if sm:
+            cfg = dataclasses.replace(cfg, slo_max_incidents=int(sm))
+        sk = os.environ.get("WF_SNAPSHOT_KEEP", "")
+        if sk:
+            cfg = dataclasses.replace(
+                cfg, snapshot_keep=(int(sk) if sk != "0" else None))
+        if cfg.snapshot_keep is not None and int(cfg.snapshot_keep) < 1:
+            raise ValueError(
+                f"snapshot_keep/WF_SNAPSHOT_KEEP must be >= 1 (or unset "
+                f"for unlimited), got {cfg.snapshot_keep}")
         if cfg.health and int(cfg.health_sample) < 1:
             raise ValueError(
                 f"health_sample/WF_HEALTH_SAMPLE must be >= 1, got "
@@ -193,14 +241,47 @@ class Monitor:
         self.registry = MetricsRegistry(name, event_time=config.event_time,
                                         health_ledger=self.health)
         self.journal: Optional[EventJournal] = None
+        journal_path = None
         if config.journal:
+            journal_path = os.path.join(config.out_dir, "events.jsonl")
             self.journal = EventJournal(
-                os.path.join(config.out_dir, "events.jsonl"),
+                journal_path,
                 flush_interval=config.journal_flush_interval)
+        #: SLO engine (MonitoringConfig.slo): resolved here so a malformed
+        #: spec set fails the run loudly at Monitor construction (the
+        #: health_sample convention; validate() reports it as WF116
+        #: pre-run), evaluated by the Reporter inside every tick
+        self.slo: Optional[slo_engine.SLOEngine] = None
+        specs = slo_engine.resolve_specs(config.slo)
+        if specs:
+            self.slo = slo_engine.SLOEngine(
+                specs, out_dir=config.out_dir,
+                cooldown_s=config.slo_cooldown_s,
+                max_incidents=config.slo_max_incidents,
+                journal_path=journal_path,
+                fingerprint=self._config_fingerprint)
         self.reporter = Reporter(self.registry, config.out_dir,
                                  interval_s=config.interval_s,
-                                 prometheus=config.prometheus)
+                                 prometheus=config.prometheus,
+                                 slo_engine=self.slo,
+                                 snapshot_keep=config.snapshot_keep)
         self._finished = False
+
+    def _config_fingerprint(self) -> dict:
+        """Chain signatures for an incident bundle's config.json — WHICH
+        compiled programs were live when the SLO paged (the TuningCache
+        keying reused as provenance; the env half lives in slo.py)."""
+        try:
+            from ..control.autotune import chain_signature
+        except ImportError:
+            return {}
+        sigs = []
+        for ch in self.registry._iter_health_chains():
+            try:
+                sigs.append(chain_signature(ch.ops))
+            except Exception:   # noqa: BLE001 — a half-built chain must not
+                continue        # kill the incident capture
+        return {"chain_signatures": sigs} if sigs else {}
 
     def start(self) -> None:
         if self.journal is not None:

@@ -630,6 +630,48 @@ def merge_snapshots(snaps: Sequence[dict],
             for k, row in rows.items():
                 ssec[f"{host}/{k}"] = dict(row)
         out["shards"] = ssec
+    # SLO sections joined by SLO name: worst state wins (code MAX, the
+    # host holding it named), burn rates MAX (the fleet view must show the
+    # worst burn), pages summed AND host-tagged — an un-tagged page total
+    # could not say WHICH host was paging.  The latest signal VALUE comes
+    # from the worst (code, burn_fast) host, never a blanket MAX: for a
+    # min-sense signal like hbm_headroom_pct, MAX would report the
+    # HEALTHIEST host's headroom on a row whose state says another host
+    # is paging
+    slo_secs = [(h, s.get("slo")) for h, s in zip(hosts, snaps)
+                if s.get("slo")]
+    if slo_secs:
+        ssec: Dict[str, dict] = {}
+        worst_key: Dict[str, tuple] = {}
+        for host, rows in slo_secs:
+            for name, row in rows.items():
+                dst = ssec.setdefault(name, {"state": "ok", "code": 0,
+                                             "pages": 0,
+                                             "pages_by_host": {}})
+                code = int(row.get("code", 0))
+                bf = row.get("burn_fast")
+                key = (code, bf if isinstance(bf, (int, float)) else 0.0)
+                if name not in worst_key or key > worst_key[name]:
+                    worst_key[name] = key
+                    dst["code"] = code
+                    dst["state"] = row.get("state", dst["state"])
+                    dst["worst_host"] = host
+                    if row.get("signal") is not None:
+                        dst["signal"] = row["signal"]
+                    else:
+                        dst.pop("signal", None)
+                for k in ("burn_fast", "burn_slow"):
+                    v = row.get(k)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        dst[k] = max(dst.get(k, v), v)
+                if row.get("target") is not None and "target" not in dst:
+                    dst["target"] = row["target"]
+                pages = int(row.get("pages", 0))
+                dst["pages"] += pages
+                if pages:
+                    dst["pages_by_host"][host] = pages
+        out["slo"] = ssec
     # health ledgers: devices concatenated (host-tagged), footprints and
     # compile counters summed, device-time summed with the dispatch-bound
     # classifier recomputed over the fleet totals

@@ -233,6 +233,30 @@ def _check_health_names() -> None:
 
 _check_health_names()
 
+#: HELP text per SLO gauge — checked against ``names.py::SLO_GAUGES`` at
+#: import (the event-time/health lockstep discipline).  Rendered as
+#: ``windflow_slo_<name>{graph,slo="..."}`` from the snapshot's ``slo``
+#: section (written by the SLO engine inside the Reporter tick).
+_SLO_HELP = {
+    "state": "SLO health state (0 ok, 1 warn, 2 page)",
+    "burn_fast": "error-budget burn rate over the fast window",
+    "burn_slow": "error-budget burn rate over the slow window",
+    "signal": "latest observed value of the SLO's signal",
+    "target": "the SLO's target threshold",
+    "pages": "PAGE transitions this run",
+}
+
+
+def _check_slo_names() -> None:
+    from .names import SLO_GAUGES
+    if set(_SLO_HELP) != set(SLO_GAUGES):
+        raise RuntimeError(
+            f"metrics.py SLO exposition drifted from "
+            f"names.py::SLO_GAUGES: {set(_SLO_HELP) ^ set(SLO_GAUGES)}")
+
+
+_check_slo_names()
+
 
 def _recovery_counters() -> Dict[str, float]:
     """Process-wide supervision counters (lazy import: runtime.faults imports
@@ -307,6 +331,11 @@ class MetricsRegistry:
         # a driver-side snapshot (Reporter.stop final emit) runs only after
         # the tick thread is joined
         self._et_names: Dict[int, str] = {}   # wf-lint: single-writer[reporter]
+        # previous tick's e2e bucket counts (same single-writer discipline):
+        # the delta gives the PER-TICK p99 the SLO latency signal needs —
+        # the cumulative histogram could never recover below a target once
+        # a stall pushed its whole-run p99 over it
+        self._e2e_prev_counts: Optional[List[int]] = None  # wf-lint: single-writer[reporter]
         self._lock = threading.Lock()
 
     # -- registration -----------------------------------------------------------------
@@ -545,13 +574,28 @@ class MetricsRegistry:
                         "last_release_count": int(o._last_release_count),
                         "mode": o.mode.name,
                     })
+        e2e = self.e2e_hist.summary_us()
+        # per-tick e2e latency: percentile over ONLY the samples recorded
+        # since the previous snapshot (bucket-count delta) — the windowed
+        # signal the SLO engine's "e2e_p99_ms" reads, so a recovered stream
+        # can flip PAGE back to OK while the cumulative p50/p95/p99 above
+        # still carry the incident
+        counts, _cnt, _sum, _mn, mx, _ex = self.e2e_hist._snap()
+        if self._e2e_prev_counts is not None:
+            delta = [max(c - p, 0) for c, p in
+                     zip(counts, self._e2e_prev_counts)]
+            dn = sum(delta)
+            e2e["samples_tick"] = dn
+            e2e["p99_tick"] = round(
+                LogHistogram._pct_value(delta, dn, mx, 99) * 1e6, 3)
+        self._e2e_prev_counts = counts
         snap = {
             "graph": self.name,
             "wall_time": time.time(),
             "uptime_s": round(now - self.created, 3),
             "operators": ops_out,
             "totals": totals,
-            "e2e_latency_us": self.e2e_hist.summary_us(),
+            "e2e_latency_us": e2e,
             "queues": queues,
             "ordering": orderings,
             # process-wide recovery/chaos counters (restarts, backoff sleeps,
@@ -736,6 +780,36 @@ class MetricsRegistry:
                                  f'{row[key]}')
 
     @staticmethod
+    def _prometheus_slo(snap: dict, lines: List[str], esc) -> None:
+        """``windflow_slo_*`` gauges from the snapshot's ``slo`` section
+        (one label set per SLO).  Only the names registered in
+        ``names.py::SLO_GAUGES`` render (the import-time lockstep check
+        above); ``state`` renders its numeric code."""
+        sec = snap.get("slo")
+        if not sec:
+            return
+        g = snap["graph"]
+        typed = set()
+
+        def head(name):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# HELP windflow_slo_{name} {_SLO_HELP[name]}")
+                lines.append(f"# TYPE windflow_slo_{name} gauge")
+
+        for slo_name, row in sorted(sec.items()):
+            lab = f'graph="{esc(g)}",slo="{esc(slo_name)}"'
+            for name in ("burn_fast", "burn_slow", "signal", "target",
+                         "pages"):
+                v = row.get(name)
+                if v is not None:
+                    head(name)
+                    lines.append(f'windflow_slo_{name}{{{lab}}} {v}')
+            if row.get("code") is not None:
+                head("state")
+                lines.append(f'windflow_slo_state{{{lab}}} {row["code"]}')
+
+    @staticmethod
     def _prometheus_event_time(snap: dict, lines: List[str], esc) -> None:
         """``windflow_event_time_*`` gauges (HELP/TYPE'd) from the snapshot's
         event-time sections: per-operator watermark/lag/occupancy/pressure,
@@ -851,6 +925,7 @@ class MetricsRegistry:
                     f'operator="{esc(row["name"])}"}} {row["counters"][c]}')
         self._prometheus_event_time(snap, lines, esc)
         self._prometheus_health(snap, lines, esc)
+        self._prometheus_slo(snap, lines, esc)
         lines.append("# TYPE windflow_queue_depth gauge")
         for edge, depth in snap["queues"].items():
             lines.append(f'windflow_queue_depth{{graph="{esc(g)}",'

@@ -75,6 +75,12 @@ JOURNAL_EVENTS = (
     # to_shards/moves/at_pos; discarded=True marks an in-flight handoff
     # manifest dropped on restore — replay re-derives the move)
     "shard_restore", "reshard",
+    # SLO engine (observability/slo.py, Reporter-tick evaluation):
+    # "slo_page" = an SLO's multi-window burn crossed page_burn on BOTH
+    # windows (slo/signal/value/target/burn_fast/burn_slow/tick — incident
+    # capture follows, rate-limited); "slo_recover" = a warned/paged SLO
+    # returned to OK (from_state says which)
+    "slo_page", "slo_recover",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -101,6 +107,10 @@ RECOVERY_COUNTERS = (
     "dead_letters", "watchdog_timeouts", "faults_injected",
     "checkpoint_saves", "checkpoint_corrupt_skipped",
     "checkpoint_fallbacks",
+    # cumulative seconds spent inside supervisor restore spans (whole-domain
+    # AND shard-local) — the per-tick delta is the SLO engine's
+    # "recovery_s" signal (observability/slo.py)
+    "recovery_seconds",
 )
 
 #: process-wide control-plane counters (``control/_state.py``; snapshot
@@ -206,6 +216,22 @@ HEALTH_GAUGES = (
     "device_ms", "dispatch_ms",                     # per stage label
     "dispatch_ratio",          # host dispatch / device time — >= 0.5 names
     #                            a fusion candidate (dispatch-bound edge)
+)
+
+#: per-SLO gauges of the ``slo`` snapshot section (``observability/slo.py``
+#: SLOEngine, evaluated inside the Reporter tick; ``metrics.py::
+#: _prometheus_slo`` renders ONLY registered names as
+#: ``windflow_slo_<name>{graph,slo=...}`` — its local HELP map is checked
+#: against this tuple at import, the HEALTH_GAUGES lockstep discipline).
+#: Folded by ``device_health.merge_snapshots`` as worst-state-wins (code
+#: MAX), burn rates MAX, pages summed + host-tagged.
+SLO_GAUGES = (
+    "state",            # health state code: 0 ok, 1 warn, 2 page
+    "burn_fast",        # error-budget burn over the fast window
+    "burn_slow",        # error-budget burn over the slow window
+    "signal",           # latest observed signal value
+    "target",           # the spec's target threshold
+    "pages",            # PAGE transitions this run
 )
 
 #: kernel families selectable through the per-backend kernel registry

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 from typing import Optional
 
@@ -49,17 +50,44 @@ def _atomic_write(path: str, data: str) -> None:
 
 class Reporter:
     def __init__(self, registry: MetricsRegistry, out_dir: str,
-                 interval_s: float = 1.0, prometheus: bool = True):
+                 interval_s: float = 1.0, prometheus: bool = True,
+                 slo_engine=None, snapshot_keep: Optional[int] = None):
         self.registry = registry
         self.out_dir = out_dir
         self.interval_s = max(0.05, float(interval_s))
         self.prometheus = prometheus
+        #: SLO engine (observability/slo.py) evaluated INSIDE every tick,
+        #: right after the registry snapshot and before the files land —
+        #: the written snapshot.json/snapshots.jsonl carry its "slo"
+        #: section, and PAGE transitions capture incident bundles on this
+        #: thread (the engine is single-writer for the same reason ticks
+        #: is: the final stop() emit runs only after join())
+        self.slo = slo_engine
+        #: keep-last-N-lines retention for snapshots.jsonl (None/0 =
+        #: unlimited, today's behavior): a long-running service's time
+        #: series must not grow without bound.  Rotation is an amortized
+        #: atomic rewrite on THIS thread (trim to N once the file reaches
+        #: 2N lines, so steady state appends instead of rewriting every
+        #: tick) — a reader polling the file sees either the pre- or
+        #: post-trim file, never a truncated line
+        self.snapshot_keep = int(snapshot_keep) if snapshot_keep else None
         # bumped by emit(): reporter ticks while running; the driver's final
         # stop() emit runs only after join() — never two writers at once
         self.ticks = 0                      # wf-lint: single-writer[reporter]
+        # SLO-engine observe() failures (same single-writer discipline): a
+        # broken signal extractor must not kill the tick, but the engine
+        # whose whole job is alerting dying silently would be worse — the
+        # count lands in every snapshot and the first failure warns once
+        self.slo_errors = 0                 # wf-lint: single-writer[reporter]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(out_dir, exist_ok=True)
+        self._jsonl_path = os.path.join(out_dir, "snapshots.jsonl")
+        # resume-aware line count (same single-writer discipline as ticks)
+        self._jsonl_lines = 0               # wf-lint: single-writer[reporter]
+        if self.snapshot_keep and os.path.exists(self._jsonl_path):
+            with open(self._jsonl_path) as f:
+                self._jsonl_lines = sum(1 for _ in f)
 
     # -- lifecycle --------------------------------------------------------------------
 
@@ -89,10 +117,39 @@ class Reporter:
 
     def emit(self) -> dict:
         snap = self.registry.snapshot()
+        if self.slo is not None:
+            try:
+                self.slo.observe(snap)
+            except Exception as e:  # noqa: BLE001 — a bad SLO tick must not
+                # kill the reporter (the snapshot still lands), but it must
+                # not die SILENTLY either: the snapshot records the error +
+                # count, and the first failure warns on stderr — otherwise a
+                # broken extractor reads as "all SLOs OK" for the whole run
+                self.slo_errors += 1
+                snap["slo_error"] = {"error": f"{type(e).__name__}: {e}",
+                                     "count": self.slo_errors}
+                if self.slo_errors == 1:
+                    print(f"wf reporter: SLO engine failed on tick "
+                          f"{self.ticks + 1} ({type(e).__name__}: {e}) — "
+                          f"burn-rate alerting is degraded; see "
+                          f"snapshot['slo_error']", file=sys.stderr)
         _atomic_write(os.path.join(self.out_dir, "snapshot.json"),
                       json.dumps(snap, indent=1, sort_keys=True))
-        with open(os.path.join(self.out_dir, "snapshots.jsonl"), "a") as f:
+        with open(self._jsonl_path, "a") as f:
             f.write(json.dumps(snap) + "\n")
+        self._jsonl_lines += 1
+        if self.snapshot_keep and self._jsonl_lines >= 2 * self.snapshot_keep:
+            # amortized: trim back to keep-N only once the file doubles —
+            # trimming on every tick past N would re-read and rewrite the
+            # whole series each second for the lifetime of a long-running
+            # service (the exact deployment retention targets).  Readers
+            # tolerate either side of the rewrite; the file is bounded at
+            # 2N-1 lines and always ends with the newest ticks
+            with open(self._jsonl_path) as f:
+                lines = f.readlines()
+            kept = lines[-self.snapshot_keep:]
+            _atomic_write(self._jsonl_path, "".join(kept))
+            self._jsonl_lines = len(kept)
         if self.prometheus:
             _atomic_write(os.path.join(self.out_dir, "metrics.prom"),
                           self.registry.to_prometheus(snap))

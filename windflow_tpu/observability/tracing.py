@@ -396,6 +396,16 @@ class Tracer:
             seg.open_spans.clear()
         return n
 
+    def snapshot_chrome(self, journal_events: Optional[list] = None) -> dict:
+        """Chrome trace-event dump of the CURRENT ring contents, without
+        finishing the tracer — the mid-run flight-recorder dump hook the
+        SLO engine's incident capture rides (``observability/slo.py``).
+        Safe from any thread: :meth:`records` reads each per-thread segment
+        through its ring-window snapshot, and open spans simply have no end
+        record yet (the exporter drops and counts unmatched begins)."""
+        return to_chrome_trace(self.records(), journal_events=journal_events,
+                               meta=self.meta())
+
     def records(self) -> List[dict]:
         """Every surviving record as dicts, globally sorted by timestamp."""
         with self._seg_lock:

@@ -38,6 +38,7 @@ sink delivery.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import List, Optional, Sequence
@@ -83,9 +84,25 @@ class ThreadedPipeline:
                  batch_size: int = DEFAULT_BATCH_SIZE,
                  queue_capacity=8, pin: bool = True,
                  heartbeat_timeout: Optional[float] = None, faults=None,
-                 prefetch: int = 0, control=None, trace=None, dispatch=None):
+                 prefetch: int = 0, control=None, trace=None, dispatch=None,
+                 monitoring=None):
         self.source = source
         self.sink = sink
+        #: telemetry opt-in (monitoring= kwarg or WF_MONITORING env — the
+        #: Pipeline/PipeGraph convention, previously missing on this
+        #: driver): segment chains + SPSC ring-depth gauges registered, e2e
+        #: latency sampled source-framing -> sink-receipt across the stage
+        #: threads, and the SLO engine riding the Reporter tick
+        self._monitoring_arg = monitoring
+        # created in run() BEFORE the stage threads start (happens-before
+        # via Thread.start); stage bodies only read the reference
+        self._monitor = None                # wf-lint: single-writer[driver]
+        # (enqueue seq, perf_counter) stamps of SAMPLED source batches: the
+        # source stage appends, the sink stage pops its matching receipt —
+        # SPSC rings preserve order, so receipt m pairs with enqueue m;
+        # deque append/popleft are GIL-atomic, and the two writers never
+        # touch the same end
+        self._e2e_stamps = collections.deque()  # wf-lint: single-writer[driver, stage]
         #: per-batch causal tracing opt-in (trace= kwarg or WF_TRACE env)
         self._trace_arg = trace
         self._tracer = None
@@ -185,7 +202,9 @@ class ThreadedPipeline:
                     pause_event=gov.pause_event if gov is not None else None)
             else:
                 batches = self.source.batches(self.batch_size)
+            mon = self._monitor
             n = 0
+            n_enq = 0
             for batch in batches:
                 self._beats[stage] = time.monotonic()
                 _faults.fire("source.next", stage=stage, pos=n)
@@ -199,8 +218,14 @@ class ThreadedPipeline:
                         gov.throttle(heartbeat=lambda: self._beats.__setitem__(
                             stage, time.monotonic()))
                         self._beats[stage] = time.monotonic()
+                    if (mon is not None and self.sink is not None
+                            and mon.config.should_sample_e2e(n_enq)):
+                        # e2e sample: stamp the ENQUEUE index (post-
+                        # admission), matched by receipt order at the sink
+                        self._e2e_stamps.append((n_enq, time.perf_counter()))
                     _tracing.event(ab, self.edge_names[0], "enq")
                     self.queues[0].push(ab)
+                    n_enq += 1
                 n += 1
             if adm is not None:
                 for ab in adm.drain():      # bounded held tail (drop_oldest)
@@ -306,6 +331,15 @@ class ThreadedPipeline:
                     self.sink.consume(item)
                 if span is not None:
                     span.done()
+                stamps = self._e2e_stamps
+                if stamps and stamps[0][0] == n:
+                    # the stamped enqueue reached its receipt: a true
+                    # source-framing -> host-receipt sample through every
+                    # ring + segment (consume materialized the batch)
+                    _seq, t0 = stamps.popleft()
+                    self._monitor.registry.record_e2e(
+                        time.perf_counter() - t0,
+                        exemplar=_tracing.tid_of(item))
                 n += 1
             if self.sink is not None:
                 self.sink.consume(None)
@@ -340,7 +374,23 @@ class ThreadedPipeline:
         injector = _faults.resolve(self._faults_arg)
         from .dispatch import DispatchConfig
         self._dispatch = DispatchConfig.resolve(self._dispatch_arg)
-        from ..observability import TraceConfig, Tracer
+        from ..observability import Monitor, MonitoringConfig, TraceConfig, \
+            Tracer
+        mcfg = MonitoringConfig.resolve(self._monitoring_arg)
+        self._e2e_stamps.clear()            # receipt indices restart at 0
+        if mcfg is not None and self._monitor is None:
+            self._monitor = Monitor(mcfg,
+                                    self.source.getName() + "-threaded")
+            reg = self._monitor.registry
+            reg.register_operator(self.source)
+            for chain in self.chains:
+                reg.register_chain(chain.label, chain)
+            if self.sink is not None:
+                reg.register_operator(self.sink)
+            for name, q in zip(self.edge_names, self.queues):
+                reg.attach_queue_gauge(name, q.size,
+                                       capacity=self.edge_capacities[name])
+            self._monitor.start()
         tcfg = TraceConfig.resolve(self._trace_arg)
         if tcfg is not None and self._tracer is None:
             self._tracer = Tracer(tcfg,
@@ -361,6 +411,10 @@ class ThreadedPipeline:
             try:
                 return self._run()
             finally:
+                if self._monitor is not None:
+                    # final snapshot + journal close; no topology target —
+                    # the export models Pipeline/PipeGraph shapes
+                    self._monitor.finish()
                 if self._tracer is not None:
                     self._tracer.finish()
                 if self.governor is not None:
