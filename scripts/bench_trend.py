@@ -30,6 +30,7 @@ REGRESSED round, 2 = no round files found / unreadable input.
 """
 
 import argparse
+import datetime
 import glob
 import json
 import os
@@ -49,6 +50,24 @@ def _round_no(path: str):
 def _last_line(tail: str) -> str:
     lines = [ln.strip() for ln in (tail or "").splitlines() if ln.strip()]
     return lines[-1] if lines else ""
+
+
+def _capture_age_days(captured_at):
+    """Age in days of a ``captured_at`` ISO-8601 stamp (the bench capture
+    wall time), or None when absent/unparseable — a STALE round re-emits a
+    LAST-GOOD capture, so the same number can ride along for many rounds;
+    the age says how old the measurement actually is."""
+    if not captured_at:
+        return None
+    try:
+        ts = datetime.datetime.fromisoformat(
+            str(captured_at).replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return max(0.0, (now - ts).total_seconds() / 86400.0)
 
 
 def load_rounds(root: str, prefix: str):
@@ -71,6 +90,7 @@ def bench_rows(rounds, threshold: float):
         rc = d.get("rc")
         row = {"round": n, "rc": rc, "value": None, "unit": "",
                "vs_baseline": None, "stale": False, "status": "",
+               "capture_age_days": None,
                "note": "", "flops_per_step": None, "bytes_per_step": None,
                "launches_per_step": None, "compiles_per_step": None,
                "shard_recovery_ms": None, "slo_pages": None}
@@ -124,9 +144,20 @@ def bench_rows(rounds, threshold: float):
             row["note"] = "parsed record without a value"
         elif row["stale"]:
             # a re-emitted last-good capture is not a fresh measurement:
-            # report it, keep it out of the best-so-far comparison
+            # report it, keep it out of the best-so-far comparison — and
+            # date it: consecutive STALE rounds repeat the SAME number, so
+            # without the capture age the table reads like a fresh plateau
             row["status"] = "STALE"
-            row["note"] = parsed.get("staleness_reason", "stale capture")
+            row["capture_age_days"] = _capture_age_days(
+                parsed.get("captured_at"))
+            note = parsed.get("staleness_reason", "stale capture")
+            if parsed.get("captured_at"):
+                age = row["capture_age_days"]
+                note += (f"; re-emits capture from "
+                         f"{parsed['captured_at']}"
+                         + (f" ({age:.0f}d old)" if age is not None
+                            else ""))
+            row["note"] = note
         elif best is None or value > best:
             row["status"] = "BEST"
             best = value
@@ -265,9 +296,9 @@ def render_markdown(bench, multichip, threshold: float,
     lines.append("## Single-chip (`BENCH_r*.json`, `parsed` metric)")
     lines.append("")
     lines.append("| round | status | value | unit | vs baseline "
-                 "| Mflop/step | MB/step | launches/step | compiles/step "
-                 "| pages/run | shard recov ms | note |")
-    lines.append("|---|---|---|---|---|---|---|---|---|---|---|---|")
+                 "| age (d) | Mflop/step | MB/step | launches/step "
+                 "| compiles/step | pages/run | shard recov ms | note |")
+    lines.append("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     for r in bench:
         mflop = (f"{r['flops_per_step'] / 1e6:.2f}"
                  if r.get("flops_per_step") else "—")
@@ -283,13 +314,17 @@ def render_markdown(bench, multichip, threshold: float,
               if r.get("slo_pages") is not None else "—")
         srm = (f"{r['shard_recovery_ms']:g}"
                if r.get("shard_recovery_ms") is not None else "—")
+        # capture age: meaningful on STALE rounds (how old the re-emitted
+        # last-good number is); fresh rounds measured "now", render —
+        age = (f"{r['capture_age_days']:.0f}"
+               if r.get("capture_age_days") is not None else "—")
         lines.append(f"| r{r['round']:02d} | {r['status']} "
                      f"| {_fmt(r['value'])} | {r['unit'] or '—'} "
-                     f"| {_fmt(r['vs_baseline'])} "
+                     f"| {_fmt(r['vs_baseline'])} | {age} "
                      f"| {mflop} | {mb} | {lps} | {cps} | {pg} | {srm} "
                      f"| {_cell(r['note'] or '')} |")
     if not bench:
-        lines.append("| — | — | — | — | — | — | — | — | — | — | — "
+        lines.append("| — | — | — | — | — | — | — | — | — | — | — | — "
                      "| no BENCH_r*.json found |")
     if nexmark is not None:
         lines += render_nexmark(*nexmark)

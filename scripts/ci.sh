@@ -61,7 +61,9 @@ stdlib_cli_contracts() {
     printf 'raise ImportError("stdlib CLIs must not import jax")\n' \
         > "$tmp/jax.py"
     # missing inputs -> exit 2, for every artifact CLI (wf_trace keys its
-    # inputs off --trace-dir rather than --monitoring-dir)
+    # inputs off --trace-dir rather than --monitoring-dir; wf_fleet reads
+    # through its status subcommand; wf_top needs --once or it would
+    # block in the live redraw loop)
     local cli dirflag
     for cli in wf_slo wf_state wf_health wf_trace; do
         dirflag="--monitoring-dir"
@@ -75,6 +77,49 @@ stdlib_cli_contracts() {
             rm -rf "$tmp"; return 1
         fi
     done
+    PYTHONPATH="$tmp" python scripts/wf_fleet.py status \
+        --monitoring-dir "$tmp/nope" >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 2 ]; then
+        echo "ci: wf_fleet.py missing-inputs contract broke (rc=${rc}," \
+             "want 2)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_top.py \
+        --monitoring-dir "$tmp/nope" --once >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 2 ]; then
+        echo "ci: wf_top.py missing-inputs contract broke (rc=${rc}," \
+             "want 2)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    # fleet loopback smoke: a one-shot agent->aggregator roundtrip on an
+    # ephemeral endpoint (wf_fleet selftest), then the live dashboard and
+    # the SLO CLI must both read the aggregator's Reporter-schema output
+    # directory unchanged — all still without jax
+    PYTHONPATH="$tmp" python scripts/wf_fleet.py selftest \
+        --out "$tmp/fleet" >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_fleet.py selftest loopback broke (rc=${rc}, want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_top.py \
+        --monitoring-dir "$tmp/fleet" --once >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_top.py on the aggregator dir broke (rc=${rc}," \
+             "want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_slo.py \
+        --monitoring-dir "$tmp/fleet" >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_slo.py on the aggregator dir broke (rc=${rc}," \
+             "want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
     # wf_slo burn contract: a series violating the latency target on every
     # tick must exit 1; a recovered tail must exit 0
     python - "$tmp" <<'PY'
@@ -114,7 +159,8 @@ PY
     fi
     rm -rf "$tmp"
     echo "stdlib CLI exit contracts ok (wf_slo 0/1/2, wf_state/wf_health/"
-    echo "wf_trace 2 on missing inputs; all without jax)"
+    echo "wf_trace/wf_fleet/wf_top 2 on missing inputs, fleet loopback"
+    echo "selftest + wf_top/wf_slo over the aggregator dir; all without jax)"
 }
 run_step "stdlib CLIs" stdlib_cli_contracts
 

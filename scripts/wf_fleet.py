@@ -1,0 +1,333 @@
+#!/usr/bin/env python3
+"""wf_fleet — fleet telemetry aggregator CLI.
+
+The daemon side of the fleet telemetry plane (``observability/fleet.py``):
+each monitored host's Reporter tick streams length-framed snapshot deltas
+over TCP/Unix socket (``MonitoringConfig.telemetry`` / ``WF_TELEMETRY``),
+and this process folds them into ONE rolling fleet view — written in the
+exact Reporter schema (``snapshot.json`` + ``snapshots.jsonl`` +
+``metrics.prom`` + ``events.jsonl``), so every existing stdlib CLI
+(``wf_slo.py`` / ``wf_health.py`` / ``wf_state.py`` / ``wf_top.py``) works
+on the aggregator directory unchanged.
+
+Subcommands:
+
+- ``serve``    — run the aggregator until SIGINT/SIGTERM::
+
+      python scripts/wf_fleet.py serve --listen tcp://0.0.0.0:9900 \\
+          --out wf_fleet --specs specs.json
+      # on every host:
+      WF_MONITORING=1 WF_TELEMETRY=tcp://aggregator:9900 python my_run.py
+
+- ``status``   — one-shot read of an aggregator (or any monitoring)
+  directory: connected hosts, fleet counters, per-SLO states.
+- ``selftest`` — one-shot agent→aggregator loopback on an ephemeral
+  endpoint (synthetic snapshots, no JAX, no network beyond loopback):
+  proves the wire framing + aggregation + artifact schema end to end.
+  CI runs this under a poisoned-JAX PYTHONPATH.
+
+Stdlib only (``observability/{journal,device_health,slo,fleet}.py`` are
+loaded by file path — the ``wf_state.py`` convention), so the aggregator
+runs on any box, without JAX installed.
+
+Exit codes: 0 = served/rendered/selftest passed, 2 = missing/unreadable
+inputs, bad endpoint, or a failed selftest (``tests/test_fleet.py`` pins
+the contract).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import signal
+import sys
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs(names=("journal", "device_health", "slo", "fleet")):
+    """Load the observability helper modules by file path under a synthetic
+    package — no windflow_tpu package import, no JAX (the wf_slo.py
+    loader, grown the fleet module)."""
+    obs = os.path.join(REPO, "windflow_tpu", "observability")
+    pkg = sys.modules.get("wf_obs")
+    if pkg is None:
+        pkg = types.ModuleType("wf_obs")
+        pkg.__path__ = [obs]
+        sys.modules["wf_obs"] = pkg
+    for name in names:
+        if f"wf_obs.{name}" in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            f"wf_obs.{name}", os.path.join(obs, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"wf_obs.{name}"] = mod
+        spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return (sys.modules["wf_obs.device_health"], sys.modules["wf_obs.slo"],
+            sys.modules["wf_obs.fleet"])
+
+
+def _resolve_specs(slo_mod, specs_arg):
+    """``--specs`` > ``WF_SLO`` env > None (fleet SLOs are opt-in on the
+    aggregator: without a spec set it still merges + writes artifacts, it
+    just never judges)."""
+    if specs_arg:
+        return slo_mod.resolve_specs(specs_arg)
+    env = os.environ.get("WF_SLO", "")
+    if env not in ("", "0"):
+        return slo_mod.resolve_specs(env)
+    return None
+
+
+# ------------------------------------------------------------ serve
+
+
+def cmd_serve(args) -> int:
+    dh, slo_mod, fleet = _load_obs()
+    try:
+        fleet.parse_endpoint(args.listen)
+    except ValueError as e:
+        print(f"wf_fleet: bad --listen endpoint: {e}", file=sys.stderr)
+        return 2
+    try:
+        specs = _resolve_specs(slo_mod, args.specs)
+    except (OSError, ValueError, TypeError) as e:
+        print(f"wf_fleet: cannot resolve the SLO spec set: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    agg = fleet.FleetAggregator(
+        args.listen, args.out, specs=specs, max_skew_s=args.max_skew,
+        cooldown_s=args.cooldown, max_incidents=args.max_incidents,
+        snapshot_keep=args.snapshot_keep)
+    try:
+        agg.start()
+    except OSError as e:
+        print(f"wf_fleet: cannot listen on {args.listen!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    stop = []
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.append(1))
+    print(f"wf_fleet: serving on {agg.endpoint} -> {args.out!r} "
+          f"({len(specs) if specs else 0} fleet SLO spec(s); "
+          f"point hosts at WF_TELEMETRY={agg.endpoint})", flush=True)
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        agg.stop()
+        print(f"wf_fleet: stopped — {agg.stats()['ticks']} fleet tick(s) "
+              f"from {agg.stats()['hosts_seen']} host(s)", flush=True)
+    return 0
+
+
+# ------------------------------------------------------------ status
+
+
+def cmd_status(args) -> int:
+    dh, slo_mod, fleet = _load_obs()
+    try:
+        snap, series = dh.load_snapshots(args.monitoring_dir)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"wf_fleet: cannot load snapshots from "
+              f"{args.monitoring_dir!r}: {type(e).__name__}: {e}\n"
+              f"(point --monitoring-dir at a wf_fleet serve --out "
+              f"directory)", file=sys.stderr)
+        return 2
+    fl = snap.get("fleet") or {}
+    if args.json:
+        print(json.dumps({
+            "monitoring_dir": args.monitoring_dir,
+            "fleet": fl,
+            "hosts": snap.get("hosts") or [],
+            "merged_from": snap.get("merged_from"),
+            "schema_mismatch": snap.get("schema_mismatch"),
+            "slo": snap.get("slo") or {},
+            "snapshots": len(series),
+        }, indent=1, sort_keys=True))
+        return 0
+    print(f"wf_fleet: {args.monitoring_dir!r} — "
+          f"{fl.get('hosts_connected', 0)}/{fl.get('hosts_seen', 0)} "
+          f"host(s) connected, {fl.get('ticks', len(series))} fleet "
+          f"tick(s), {fl.get('frames_received', 0)} frame(s) "
+          f"({fl.get('frames_torn', 0)} torn)")
+    if snap.get("schema_mismatch"):
+        print(f"wf_fleet: MIXED-SCHEMA fleet — per-host snapshot schema "
+              f"versions differ: "
+              f"{json.dumps(snap['schema_mismatch'], sort_keys=True)}")
+    for h in snap.get("hosts") or []:
+        conn = ("" if "connected" not in h else
+                ("  [LIVE]" if h["connected"] else "  [GONE]"))
+        mon = f"  mon_dir={h['mon_dir']}" if h.get("mon_dir") else ""
+        print(f"  host {h.get('host', '?'):<12} "
+              f"graph={h.get('graph', '?')}{mon}{conn}")
+    slo = snap.get("slo") or {}
+    for name in sorted(slo):
+        row = slo[name]
+        print(f"  slo  {name:<16} state={row.get('state', '?'):<5} "
+              f"burn_fast={row.get('burn_fast', 0):g} "
+              f"burn_slow={row.get('burn_slow', 0):g} "
+              f"pages={row.get('pages', 0)}")
+    return 0
+
+
+# ------------------------------------------------------------ selftest
+
+
+def _synthetic_snap(host: str, tick: int) -> dict:
+    """A minimal-but-schema-complete Reporter snapshot (the shape
+    ``MetricsRegistry.snapshot`` emits) for the loopback selftest."""
+    return {
+        "graph": "selftest", "schema": 1, "wall_time": time.time(),
+        "uptime_s": float(tick), "ticks": tick,
+        "operators": [
+            {"name": "src", "role": "source", "outputs": 32 * (tick + 1),
+             "inputs": 0, "drops": 0, "service_time_us": {"p50": 10.0},
+             "service_samples": tick + 1},
+            {"name": "map", "role": "map", "outputs": 32 * (tick + 1),
+             "inputs": 32 * (tick + 1), "drops": 0,
+             "service_time_us": {"p50": 20.0}, "service_samples": tick + 1},
+        ],
+        "totals": {"outputs": 32 * (tick + 1), "drops": 0},
+        "e2e_latency_us": {"p50": 100.0, "p95": 150.0, "p99": 200.0,
+                           "samples": tick + 1},
+        "queues": {"src->map": 1 + (tick % 2)},
+        "ordering": {}, "recovery": {}, "control": {"counters": {}},
+    }
+
+
+def cmd_selftest(args) -> int:
+    import tempfile
+    dh, slo_mod, fleet = _load_obs()
+    out = args.out or tempfile.mkdtemp(prefix="wf_fleet_selftest_")
+    agg = fleet.FleetAggregator("127.0.0.1:0", out, max_skew_s=0.2)
+    agg.start()
+    hosts = ("host0", "host1")
+    agents = [fleet.TelemetryAgent(agg.endpoint, host=h, outbox=8)
+              for h in hosts]
+    failures = []
+    try:
+        for a in agents:
+            a.start()
+        for tick in range(args.ticks):
+            for h, a in zip(hosts, agents):
+                a.offer(_synthetic_snap(h, tick))
+            time.sleep(0.05)
+        # the aggregator emits on round-complete; give the last round a
+        # beat to land before tearing the agents down
+        deadline = time.monotonic() + 5.0
+        while (agg.stats()["frames_received"] < args.ticks * len(hosts)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        for a in agents:
+            st = a.stats()
+            if st["frames_dropped"]:
+                failures.append(f"agent dropped {st['frames_dropped']} "
+                                f"frame(s) against a live aggregator")
+            if st["frames_sent"] != args.ticks:
+                failures.append(f"agent sent {st['frames_sent']} != "
+                                f"{args.ticks} frames")
+    finally:
+        for a in agents:
+            a.close()
+        agg.stop()
+    try:
+        snap, series = dh.load_snapshots(out)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        failures.append(f"aggregator artifacts unreadable: "
+                        f"{type(e).__name__}: {e}")
+        snap, series = {}, []
+    if snap:
+        if snap.get("merged_from") != len(hosts):
+            failures.append(f"merged_from={snap.get('merged_from')} != "
+                            f"{len(hosts)}")
+        if not snap.get("fleet", {}).get("ticks"):
+            failures.append("no fleet ticks recorded in snapshot.json")
+        # the merged view must stay CLI-compatible: totals summed across
+        # hosts, queues MAX-folded, e2e latency present
+        want = len(hosts) * 32 * args.ticks
+        got = (snap.get("totals") or {}).get("outputs")
+        if got != want:
+            failures.append(f"merged totals.outputs={got} != {want}")
+    ev = [e.get("event") for e in dh.load_journal(out)]
+    if "fleet_host_join" not in ev:
+        failures.append("no fleet_host_join journal event")
+    if not os.path.exists(os.path.join(out, "metrics.prom")):
+        failures.append("metrics.prom missing")
+    if failures:
+        print("wf_fleet selftest: FAIL\n  " + "\n  ".join(failures),
+              file=sys.stderr)
+        return 2
+    print(f"wf_fleet selftest: OK — {len(series)} fleet tick(s) from "
+          f"{len(hosts)} loopback host(s) -> {out!r}")
+    return 0
+
+
+# ------------------------------------------------------------ main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_fleet",
+        description="windflow_tpu fleet telemetry aggregator (serve / "
+                    "status / selftest)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sv = sub.add_parser("serve", help="run the aggregator daemon")
+    sv.add_argument("--listen", default="tcp://127.0.0.1:9900",
+                    help="endpoint to accept host streams on "
+                         "(tcp://HOST:PORT or unix:///path.sock; "
+                         "port 0 = ephemeral)")
+    sv.add_argument("--out", default="wf_fleet",
+                    help="aggregator output directory (Reporter schema: "
+                         "snapshot.json + snapshots.jsonl + metrics.prom "
+                         "+ events.jsonl + incidents/)")
+    sv.add_argument("--specs", default=None, metavar="JSON",
+                    help="fleet SLO spec set (JSON file path or inline "
+                         "JSON; default WF_SLO env, else no fleet SLOs)")
+    sv.add_argument("--max-skew", type=float, default=1.0,
+                    help="straggler timeout: emit a partial fleet tick "
+                         "if a round stays incomplete this long (s)")
+    sv.add_argument("--cooldown", type=float, default=60.0,
+                    help="fleet incident capture cooldown (s)")
+    sv.add_argument("--max-incidents", type=int, default=8,
+                    help="retained fleet incident bundles")
+    sv.add_argument("--snapshot-keep", type=int, default=None,
+                    help="keep-last-N retention for the fleet "
+                         "snapshots.jsonl (default unlimited)")
+    sv.set_defaults(fn=cmd_serve)
+
+    st = sub.add_parser("status", help="one-shot aggregator dir summary")
+    st.add_argument("--monitoring-dir", default="wf_fleet",
+                    help="aggregator output directory to read")
+    st.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    st.set_defaults(fn=cmd_status)
+
+    se = sub.add_parser("selftest",
+                        help="one-shot agent->aggregator loopback proof")
+    se.add_argument("--out", default=None,
+                    help="write the loopback aggregator artifacts here "
+                         "(default: a fresh temp dir)")
+    se.add_argument("--ticks", type=int, default=5,
+                    help="synthetic Reporter ticks per loopback host")
+    se.set_defaults(fn=cmd_selftest)
+
+    args = ap.parse_args(argv)
+    try:
+        _load_obs()
+    except (OSError, ImportError, SyntaxError) as e:
+        print(f"wf_fleet: cannot load observability helpers from "
+              f"{REPO!r}: {type(e).__name__}: {e}\n"
+              f"(keep scripts/wf_fleet.py next to its windflow_tpu tree — "
+              f"it reuses the telemetry plane by file path)",
+              file=sys.stderr)
+        return 2
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

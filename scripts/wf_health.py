@@ -365,6 +365,8 @@ def main(argv=None) -> int:
         if snap.get("hosts"):
             out["hosts"] = snap["hosts"]
             out["merged_from"] = snap.get("merged_from")
+        if snap.get("schema_mismatch"):
+            out["schema_mismatch"] = snap["schema_mismatch"]
         print(json.dumps(out, indent=1, sort_keys=True))
         return 0
     head = (f"wf_health: merged {snap.get('merged_from')} host(s): "
@@ -373,6 +375,12 @@ def main(argv=None) -> int:
             f"wf_health: {args.monitoring_dir!r}")
     print(f"{head} — graph {snap.get('graph', '?')!r}, {len(series)} "
           f"snapshot(s), {len(journal)} journal event(s)")
+    if snap.get("schema_mismatch"):
+        # merge_snapshots flags mixed snapshot generations, never folds
+        # them silently — keep the flag visible at the top of the report
+        print(f"wf_health: MIXED-SCHEMA fleet — per-host snapshot schema "
+              f"versions differ: "
+              f"{json.dumps(snap['schema_mismatch'], sort_keys=True)}")
     blocks = []
     if args.report in ("all", "memory"):
         blocks.append(memory_report(snap, series))

@@ -20,6 +20,14 @@ runs — ``observability/slo.py::evaluate_series``) and renders:
 Spec source precedence: ``--specs`` (JSON file path or inline JSON) >
 ``WF_SLO`` env (same forms) > the built-in default spec set.
 
+**Fleet mode**: ``--merge DIR [DIR...]`` folds N per-host monitoring
+directories into one fleet series (``device_health.merge_monitoring_dirs``)
+and evaluates the spec set over the MERGED view — the same burn math the
+live fleet aggregator (``observability/fleet.py``) runs. A fleet
+aggregator's own output directory is also a plain monitoring dir: point
+``--monitoring-dir`` at it and everything (burn table, timeline, incident
+ledger) renders unchanged.
+
 Produce the inputs with::
 
     WF_MONITORING=1 WF_SLO=1 python my_run.py
@@ -160,6 +168,11 @@ def main(argv=None) -> int:
     ap.add_argument("--monitoring-dir", default="wf_monitoring",
                     help="monitoring output directory (snapshots.jsonl + "
                          "snapshot.json + events.jsonl + incidents/)")
+    ap.add_argument("--merge", nargs="+", default=None, metavar="DIR",
+                    help="merge N per-host monitoring directories (or "
+                         "snapshots.jsonl paths) into one fleet series and "
+                         "evaluate the spec set over the merged view "
+                         "instead of reading --monitoring-dir")
     ap.add_argument("--specs", default=None, metavar="JSON",
                     help="SLO spec set: a JSON file path or inline JSON "
                          "(list of {name,signal,target,...}); default: "
@@ -215,10 +228,14 @@ def main(argv=None) -> int:
               + "\n  ".join(problems), file=sys.stderr)
         return 2
     try:
-        _latest, series = dh.load_snapshots(args.monitoring_dir)
+        if args.merge:
+            _latest, series, _journal = dh.merge_monitoring_dirs(args.merge)
+        else:
+            _latest, series = dh.load_snapshots(args.monitoring_dir)
     except (OSError, ValueError, json.JSONDecodeError) as e:
+        where = args.merge or args.monitoring_dir
         print(f"wf_slo: cannot load snapshots from "
-              f"{args.monitoring_dir!r}: {type(e).__name__}: {e}\n"
+              f"{where!r}: {type(e).__name__}: {e}\n"
               f"(run with WF_MONITORING=1 — add WF_SLO=1 for live "
               f"evaluation + incident capture)", file=sys.stderr)
         return 2
@@ -227,11 +244,23 @@ def main(argv=None) -> int:
 
     report = slo_mod.evaluate_series(specs, series)
     burning = slo_mod.burning(report)
-    bundles, torn = slo_mod.list_incidents(args.monitoring_dir)
+    if args.merge:
+        bundles, torn = [], []
+    else:
+        bundles, torn = slo_mod.list_incidents(args.monitoring_dir)
+    # mixed-schema fleets are flagged, never silently folded
+    # (device_health.merge_snapshots stamps schema_mismatch): surface the
+    # per-host schema map so a reader knows the merged numbers span
+    # incompatible snapshot generations
+    mismatch = _latest.get("schema_mismatch") or next(
+        (s.get("schema_mismatch") for s in reversed(series)
+         if s.get("schema_mismatch")), None)
 
     if args.json:
         print(json.dumps({
-            "monitoring_dir": args.monitoring_dir,
+            "monitoring_dir": (None if args.merge else args.monitoring_dir),
+            "merged_dirs": args.merge,
+            "schema_mismatch": mismatch,
             "snapshots": len(series),
             "specs": [{"name": s.name, "signal": s.signal,
                        "target": s.target, "objective": s.objective,
@@ -246,10 +275,18 @@ def main(argv=None) -> int:
         }, indent=1, sort_keys=True, default=str))
         return 1 if burning else 0
 
-    print(f"wf_slo: {args.monitoring_dir!r} — {len(series)} snapshot(s), "
+    head = (f"wf_slo: merged {_latest.get('merged_from')} host(s): "
+            + ", ".join(h.get("host", "?")
+                        for h in _latest.get("hosts", []))
+            if args.merge else f"wf_slo: {args.monitoring_dir!r}")
+    print(f"{head} — {len(series)} snapshot(s), "
           f"{len(specs)} SLO spec(s)"
           + (f", BURNING: {', '.join(burning)}" if burning
              else ", all OK"))
+    if mismatch:
+        print(f"wf_slo: MIXED-SCHEMA fleet — per-host snapshot schema "
+              f"versions differ: {json.dumps(mismatch, sort_keys=True)} "
+              f"(merged numbers span incompatible snapshot generations)")
     blocks = []
     if args.report in ("all", "burn"):
         blocks.append(burn_table(report))
@@ -259,7 +296,17 @@ def main(argv=None) -> int:
         if args.report == "all" and rec:
             blocks.append(rec)
     if args.report in ("all", "incidents"):
-        blocks.append(incidents_section(slo_mod, args.monitoring_dir))
+        if args.merge:
+            if args.report == "incidents":
+                blocks.append(
+                    ["== incident bundles ==",
+                     "  (not available in the --merge fleet view — "
+                     "bundles live under each host's own "
+                     "<monitoring_dir>/incidents/; a live fleet "
+                     "aggregator correlates them into fleet bundles "
+                     "under its own dir — point --monitoring-dir there)"])
+        else:
+            blocks.append(incidents_section(slo_mod, args.monitoring_dir))
     for b in blocks:
         print()
         print("\n".join(b))

@@ -390,6 +390,8 @@ def main(argv=None) -> int:
         if snap.get("hosts"):
             out["hosts"] = snap["hosts"]
             out["merged_from"] = snap.get("merged_from")
+        if snap.get("schema_mismatch"):
+            out["schema_mismatch"] = snap["schema_mismatch"]
         print(json.dumps(out, indent=1, sort_keys=True))
         return 0
     blocks = []
@@ -425,6 +427,12 @@ def main(argv=None) -> int:
     print(f"{head} — graph "
           f"{snap.get('graph', '?')!r}, {len(series)} snapshot(s), "
           f"{len(journal)} journal event(s)")
+    if snap.get("schema_mismatch"):
+        # merge_snapshots flags mixed snapshot generations, never folds
+        # them silently — keep the flag visible at the top of the report
+        print(f"wf_state: MIXED-SCHEMA fleet — per-host snapshot schema "
+              f"versions differ: "
+              f"{json.dumps(snap['schema_mismatch'], sort_keys=True)}")
     for b in blocks:
         print()
         print("\n".join(b))

@@ -1,0 +1,361 @@
+#!/usr/bin/env python3
+"""wf_top — live terminal dashboard over a monitoring directory.
+
+``top`` for a windflow_tpu run (or a whole fleet): polls the Reporter's
+atomic artifacts (``snapshot.json`` + ``snapshots.jsonl``) and redraws a
+one-screen view every ``--interval`` seconds:
+
+- **stages** — per-operator throughput (live rates the registry computed,
+  else a series delta), service-time p50/p99, drops;
+- **queues** — ring depth vs capacity with a bar gauge ([FULL] at the
+  watermark — the backpressure point at a glance);
+- **event time** — the min-watermark frontier (who holds the graph back)
+  and per-edge watermark skew, when the run recorded them;
+- **shards** — per-shard occupancy with the [HOT] marker (fleet merges
+  host-tag the keys, so the view names WHICH host's shard);
+- **SLOs** — per-SLO OK/WARN/PAGE with fast/slow burn and a burn trend
+  sparkline over the recent ticks;
+- **HBM** — per-device headroom, when the health ledger is on;
+- **fleet** — hosts connected / frames / torn-frame counters, when the
+  directory is a ``wf_fleet.py serve`` aggregator output.
+
+Point it at any monitoring dir — a single host's, or a fleet aggregator's
+(the aggregator writes the exact Reporter schema, so everything renders
+unchanged)::
+
+    python scripts/wf_top.py --monitoring-dir wf_monitoring
+    python scripts/wf_top.py --monitoring-dir wf_fleet --interval 0.5
+
+``--once`` renders a single frame without clearing the screen (the CI
+mode). Stdlib only (``observability/device_health.py`` is loaded by file
+path — the ``wf_state.py`` convention): works on any box the artifacts
+were copied to, without JAX installed.
+
+Exit codes: 0 = rendered (or interrupted with ctrl-C), 2 =
+missing/unreadable inputs (``tests/test_fleet.py`` pins the contract).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_STATE = {0: "ok", 1: "warn", 2: "page"}
+_SPARK = "_.-~^"                      # burn sparkline ramp (low -> high)
+
+
+def _load_obs(names=("journal", "device_health", "slo")):
+    """Load the observability helper modules by file path under a synthetic
+    package — no windflow_tpu package import, no JAX (the wf_slo.py
+    loader)."""
+    obs = os.path.join(REPO, "windflow_tpu", "observability")
+    pkg = sys.modules.get("wf_obs")
+    if pkg is None:
+        pkg = types.ModuleType("wf_obs")
+        pkg.__path__ = [obs]
+        sys.modules["wf_obs"] = pkg
+    for name in names:
+        if f"wf_obs.{name}" in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            f"wf_obs.{name}", os.path.join(obs, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"wf_obs.{name}"] = mod
+        spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return sys.modules["wf_obs.device_health"]
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "—"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _bar(frac, width=12):
+    frac = max(0.0, min(1.0, frac))
+    full = int(round(frac * width))
+    return "#" * full + "." * (width - full)
+
+
+def _spark(values, lo=0.0, hi=None):
+    """A tiny ASCII sparkline (portable: no unicode blocks)."""
+    if not values:
+        return ""
+    hi = hi if hi is not None else max(values) or 1.0
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        idx = int((max(lo, min(hi, v)) - lo) / span * (len(_SPARK) - 1))
+        out.append(_SPARK[idx])
+    return "".join(out)
+
+
+# ------------------------------------------------------------ panels
+
+
+def header(snap, series, mon_dir):
+    lines = [f"wf_top — {mon_dir!r}  graph={snap.get('graph', '?')!r}  "
+             f"uptime={snap.get('uptime_s', 0):.1f}s  "
+             f"snapshots={len(series)}  "
+             f"{time.strftime('%H:%M:%S', time.localtime())}"]
+    fl = snap.get("fleet")
+    if fl:
+        lines.append(
+            f"fleet: {fl.get('hosts_connected', 0)}/"
+            f"{fl.get('hosts_seen', 0)} host(s) connected  "
+            f"ticks={fl.get('ticks', 0)}  "
+            f"frames={fl.get('frames_received', 0)} "
+            f"({fl.get('frames_torn', 0)} torn)")
+    if snap.get("hosts"):
+        lines.append("hosts: " + "  ".join(
+            f"{h.get('host', '?')}"
+            + ("" if "connected" not in h
+               else ("[LIVE]" if h["connected"] else "[GONE]"))
+            for h in snap["hosts"]))
+    if snap.get("schema_mismatch"):
+        lines.append(f"MIXED-SCHEMA fleet: "
+                     f"{json.dumps(snap['schema_mismatch'], sort_keys=True)}")
+    tel = snap.get("telemetry")
+    if tel:
+        lines.append(
+            f"telemetry: {'up' if tel.get('connected') else 'DOWN'}  "
+            f"sent={tel.get('frames_sent', 0)}  "
+            f"dropped={tel.get('frames_dropped', 0)}  "
+            f"outbox={tel.get('outbox_depth', 0)}")
+    return lines
+
+
+def _series_rate(series, name, field="outputs_sent"):
+    """tuples/s from the last two snapshots carrying the operator —
+    the fallback when the registry didn't compute live rates."""
+    pts = []
+    for s in series[-2:]:
+        wall = s.get("wall_time")
+        for row in s.get("operators") or []:
+            if isinstance(row, dict) and row.get("name") == name:
+                pts.append((wall, row.get(field)))
+    if len(pts) == 2 and None not in pts[0] and None not in pts[1]:
+        dt = pts[1][0] - pts[0][0]
+        if dt > 0:
+            return (pts[1][1] - pts[0][1]) / dt
+    return None
+
+
+def stages_panel(snap, series):
+    lines = ["== stages =="]
+    ops = [r for r in (snap.get("operators") or []) if isinstance(r, dict)]
+    if not ops:
+        lines.append("  (no operator rows yet)")
+        return lines
+    lines.append(f"  {'operator':<18} {'in tps':>10} {'out tps':>10} "
+                 f"{'batches/s':>10} {'svc p50':>9} {'svc p99':>9} "
+                 f"{'drops':>7}")
+    for row in ops:
+        name = str(row.get("name", "?"))
+        tin = row.get("rate_in_tps")
+        tout = row.get("rate_out_tps")
+        if not tout:
+            tout = _series_rate(series, name) or tout
+        bps = row.get("rate_batches_in_per_s")
+        svc = row.get("service_time_us") or {}
+        drops = (row.get("tuples_dropped_old", 0) or 0) + \
+            (row.get("drops", 0) or 0)
+        hosts = row.get("hosts")
+        tag = f" ({len(hosts)} hosts)" if hosts else ""
+        lines.append(
+            f"  {name + tag:<18} "
+            f"{(f'{tin:,.0f}' if tin else '—'):>10} "
+            f"{(f'{tout:,.0f}' if tout else '—'):>10} "
+            f"{(f'{bps:,.1f}' if bps else '—'):>10} "
+            f"{svc.get('p50', 0):>8.0f}u {svc.get('p99', 0):>8.0f}u "
+            f"{drops:>7}")
+    e2e = snap.get("e2e_latency_us") or {}
+    if e2e:
+        lines.append(f"  e2e latency: p50={e2e.get('p50', 0):.0f}us  "
+                     f"p95={e2e.get('p95', 0):.0f}us  "
+                     f"p99={e2e.get('p99', 0):.0f}us")
+    return lines
+
+
+def queues_panel(snap):
+    lines = ["== queues =="]
+    queues = snap.get("queues") or {}
+    if not queues:
+        lines.append("  (no ring gauges — threaded/pipegraph drivers "
+                     "publish these)")
+        return lines
+    caps = snap.get("queue_capacity") or {}
+    for edge in sorted(queues):
+        depth = queues[edge]
+        cap = caps.get(edge)
+        if cap:
+            frac = depth / cap
+            flag = "  [FULL]" if depth >= cap else ""
+            lines.append(f"  {edge:<24} {depth:>4}/{cap:<4} "
+                         f"[{_bar(frac)}]{flag}")
+        else:
+            lines.append(f"  {edge:<24} {depth:>4}")
+    return lines
+
+
+def event_time_panel(snap):
+    et = snap.get("event_time") or {}
+    if not et:
+        return None
+    lines = ["== event time =="]
+    if et.get("min_watermark_ts") is not None:
+        front = et.get("frontier_operator")
+        lines.append(f"  min watermark: {et['min_watermark_ts']}"
+                     + (f"  (frontier: {front})" if front else ""))
+    for edge, skew in sorted((et.get("edge_skew_ts") or {}).items()):
+        lines.append(f"  skew {edge:<22} {skew:+}")
+    return lines
+
+
+def shards_panel(snap):
+    shards = snap.get("shards") or {}
+    if not shards:
+        return None
+    lines = ["== shards =="]
+    hot = max(shards, key=lambda k: shards[k].get("occupancy_tuples", 0))
+    peak = max((r.get("occupancy_tuples", 0) for r in shards.values()),
+               default=0) or 1
+    for k in sorted(shards, key=lambda x: (len(x), x)):
+        r = shards[k]
+        occ = r.get("occupancy_tuples", 0)
+        flag = "  [HOT]" if k == hot and len(shards) > 1 else ""
+        lines.append(f"  {k:<14} tuples={occ:<8} "
+                     f"[{_bar(occ / peak)}] restarts={r.get('restarts', 0)}"
+                     f"{flag}")
+    return lines
+
+
+def slo_panel(snap, series):
+    slo = snap.get("slo") or {}
+    if not slo:
+        return None
+    lines = ["== SLOs =="]
+    lines.append(f"  {'slo':<16} {'state':<6} {'signal':>10} "
+                 f"{'burn_fast':>9} {'burn_slow':>9} {'pages':>5}  trend")
+    for name in sorted(slo):
+        row = slo[name]
+        if not isinstance(row, dict):
+            continue
+        state = row.get("state") or _STATE.get(row.get("code"), "?")
+        flag = {"page": "  [PAGE]", "warn": "  [WARN]"}.get(state, "")
+        hist = [(s.get("slo") or {}).get(name, {}).get("burn_fast", 0.0)
+                for s in series[-24:]]
+        v = row.get("signal")
+        lines.append(
+            f"  {name:<16} {state:<6} "
+            f"{(f'{v:g}' if v is not None else '—'):>10} "
+            f"{row.get('burn_fast', 0):>9g} {row.get('burn_slow', 0):>9g} "
+            f"{row.get('pages', 0):>5}  {_spark(hist)}{flag}")
+    if snap.get("slo_error"):
+        lines.append(f"  SLO ENGINE DEGRADED: {snap['slo_error']}")
+    return lines
+
+
+def hbm_panel(snap):
+    devices = (snap.get("health") or {}).get("devices") or []
+    rows = [d for d in devices if d.get("headroom_bytes") is not None
+            or d.get("bytes_in_use") is not None]
+    if not rows:
+        return None
+    lines = ["== HBM =="]
+    risky = set((snap.get("health") or {}).get("headroom_risk") or [])
+    for d in rows:
+        label = d.get("device", "?")
+        flag = "  [LOW]" if label in risky else ""
+        lines.append(f"  {label:<12} in_use={_fmt_bytes(d.get('bytes_in_use'))} "
+                     f"headroom={_fmt_bytes(d.get('headroom_bytes'))}{flag}")
+    return lines
+
+
+def render(dh, mon_dir) -> str:
+    snap, series = dh.load_snapshots(mon_dir)
+    if not series:
+        series = [snap]
+    blocks = [header(snap, series, mon_dir), stages_panel(snap, series),
+              queues_panel(snap)]
+    for panel in (event_time_panel(snap), shards_panel(snap),
+                  slo_panel(snap, series), hbm_panel(snap)):
+        if panel:
+            blocks.append(panel)
+    return "\n\n".join("\n".join(b) for b in blocks)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_top",
+        description="live terminal dashboard over a windflow_tpu "
+                    "monitoring (or fleet aggregator) directory")
+    ap.add_argument("--monitoring-dir", default="wf_monitoring",
+                    help="monitoring output directory (a host's, or a "
+                         "wf_fleet.py serve --out aggregator's)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="redraw period in seconds (default 1.0)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clear — "
+                         "the CI/scripting mode)")
+    args = ap.parse_args(argv)
+
+    try:
+        dh = _load_obs()
+    except (OSError, ImportError, SyntaxError) as e:
+        print(f"wf_top: cannot load observability helpers from {REPO!r}: "
+              f"{type(e).__name__}: {e}\n"
+              f"(keep scripts/wf_top.py next to its windflow_tpu tree — it "
+              f"reuses the snapshot readers by file path)", file=sys.stderr)
+        return 2
+
+    if args.once:
+        try:
+            print(render(dh, args.monitoring_dir))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"wf_top: cannot load snapshots from "
+                  f"{args.monitoring_dir!r}: {type(e).__name__}: {e}\n"
+                  f"(run with WF_MONITORING=1, or point --monitoring-dir "
+                  f"at a wf_fleet aggregator output)", file=sys.stderr)
+            return 2
+        return 0
+
+    # live mode: the FIRST read must succeed (catch bad paths up front,
+    # exit 2); after that, transient read races with the writer's atomic
+    # replace just keep the previous frame for one interval
+    try:
+        frame = render(dh, args.monitoring_dir)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"wf_top: cannot load snapshots from "
+              f"{args.monitoring_dir!r}: {type(e).__name__}: {e}\n"
+              f"(run with WF_MONITORING=1, or point --monitoring-dir at a "
+              f"wf_fleet aggregator output)", file=sys.stderr)
+        return 2
+    try:
+        while True:
+            # ANSI home+clear-to-end keeps the redraw flicker-free on any
+            # terminal; fall back gracefully when not a tty (plain append)
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[H\x1b[2J")
+            print(frame, flush=True)
+            time.sleep(max(0.05, args.interval))
+            try:
+                frame = render(dh, args.monitoring_dir)
+            except (OSError, ValueError, json.JSONDecodeError):
+                pass                 # keep last frame; writer mid-replace
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Per-process host driver for the live-fleet acceptance test.
+
+Run as::
+
+    python tests/fleet_host_driver.py <endpoint> <host_tag> <mon_dir> <faults>
+
+from the repo root. Runs the test_slo.py chaos geometry through the
+monitored ThreadedPipeline with the telemetry plane on, streaming every
+Reporter tick to the parent test's in-process FleetAggregator at
+``<endpoint>``. ``<faults>`` = 1 injects the queue.stall chaos plan (the
+stalled phase that saturates both burn windows, then the healthy tail the
+fast window recovers on); 0 runs clean.
+
+Prints ``FLEET-HOST-OK rows=<n> sent=<s> dropped=<d>`` on success — the
+parent parses the sentinel and additionally reads this host's own
+monitoring artifacts (the telemetry plane must never perturb them).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+endpoint, host_tag, mon_dir, faults = (sys.argv[1], sys.argv[2],
+                                       sys.argv[3], sys.argv[4] == "1")
+
+os.environ["WF_TELEMETRY_HOST"] = host_tag
+
+import json  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+import windflow_tpu as wf  # noqa: E402
+from windflow_tpu.observability import MonitoringConfig  # noqa: E402
+from windflow_tpu.runtime.faults import FaultPlan, FaultSpec  # noqa: E402
+
+spec = [{"name": "latency", "signal": "e2e_p99_ms", "target": 30.0,
+         "objective": 0.5, "fast_window": 3, "slow_window": 6,
+         "warn_burn": 1.0, "page_burn": 2.0}]
+cfg = MonitoringConfig(out_dir=mon_dir, interval_s=0.02, slo=spec,
+                       e2e_sample_every=1, telemetry=endpoint)
+
+plan = None
+if faults:
+    plan = FaultPlan([
+        FaultSpec("queue.stall", kind="stall", stall_s=0.05,
+                  at=list(range(6, 60))),
+        FaultSpec("queue.stall", kind="stall", stall_s=0.002,
+                  at=list(range(60, 500))),
+    ], seed=3)
+
+src = wf.Source(lambda i: {"v": i.astype(jnp.float32)},
+                total=420 * 32, num_keys=4)
+rows = []
+tp = wf.ThreadedPipeline(
+    src, [[wf.Map(lambda t: {"v": t.v * 2})]],
+    wf.Sink(lambda v: rows.append(0) if v is not None else None),
+    batch_size=32, queue_capacity=2, faults=plan, monitoring=cfg)
+tp.run()
+
+with open(os.path.join(mon_dir, "snapshot.json")) as f:
+    snap = json.load(f)
+tel = snap.get("telemetry") or {}
+print(f"FLEET-HOST-OK rows={len(rows)} sent={tel.get('frames_sent', 0)} "
+      f"dropped={tel.get('frames_dropped', 0)}", flush=True)

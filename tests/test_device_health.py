@@ -462,6 +462,79 @@ def test_merge_snapshots_fleet_semantics():
     assert m["control"]["counters"]["shed_batches"] == 4
 
 
+def test_merge_tolerates_partial_host():
+    """A host whose snapshot is missing whole sections (torn mid-upgrade,
+    or a seed-era emitter) still folds — the merge never KeyErrors, it
+    just contributes nothing to the sections it lacks."""
+    full = _host_snap(10, 40, 3, 100)
+    partial = {"graph": "g", "operators": [
+        {"name": "join", "inputs_received": 7}]}
+    m = dh.merge_snapshots([full, partial], hosts=["h0", "h1"])
+    assert m["merged_from"] == 2
+    assert m["totals"]["inputs_received"] == 100      # full host only
+    assert m["operators"][0]["inputs_received"] == 107
+    assert m["queues"]["src->0"] == 40
+    assert m["event_time"]["frontier_host"] == "h0"
+    assert len(m["health"]["devices"]) == 1
+    # and in the other order (partial host first sets the fold's seed)
+    m2 = dh.merge_snapshots([partial, full], hosts=["h1", "h0"])
+    assert m2["operators"][0]["inputs_received"] == 107
+
+
+def test_merge_duplicate_host_tags_disambiguated():
+    """Two --merge dirs with the same basename must not fold into one
+    host's rows — duplicate tags get a #N suffix so host-tagged sections
+    (devices, hosts) keep every host's data."""
+    snaps = [_host_snap(10, 40, 1, 10), _host_snap(9, 50, 1, 20),
+             _host_snap(8, 60, 1, 30)]
+    m = dh.merge_snapshots(snaps, hosts=["mon", "mon", "mon"])
+    assert [h["host"] for h in m["hosts"]] == ["mon", "mon#2", "mon#3"]
+    assert {d["device"] for d in m["health"]["devices"]} == {
+        "mon/tpu:0", "mon#2/tpu:0", "mon#3/tpu:0"}
+    assert m["totals"]["inputs_received"] == 60
+
+
+def test_merge_seed_era_schema_reads_as_zero():
+    """Seed-era snapshots carry no schema field: they fold as version 0,
+    and mixing them with stamped hosts flags — never silently folds —
+    the disagreement."""
+    old, new = _host_snap(1, 1, 1, 1), _host_snap(1, 1, 1, 1)
+    new["schema"] = dh.SNAPSHOT_SCHEMA
+    m = dh.merge_snapshots([old, new], hosts=["h0", "h1"])
+    assert m["schema"] == dh.SNAPSHOT_SCHEMA
+    assert m["schema_mismatch"] == {"h0": 0, "h1": dh.SNAPSHOT_SCHEMA}
+    # an all-seed-era fleet agrees with itself: version 0, no flag
+    m0 = dh.merge_snapshots([_host_snap(1, 1, 1, 1)] * 2,
+                            hosts=["h0", "h1"])
+    assert m0["schema"] == 0 and "schema_mismatch" not in m0
+
+
+def test_merge_monitoring_dirs_torn_host(tmp_path):
+    """A host dir whose snapshots.jsonl was torn mid-append (the host
+    died writing) still merges: the torn tail is dropped by the loader,
+    the series aligns to the shortest host, the journal concatenates."""
+    for name, ticks, torn in (("ha", 3, False), ("hb", 2, True)):
+        d = tmp_path / name
+        d.mkdir()
+        with open(d / "snapshots.jsonl", "w") as f:
+            for i in range(ticks):
+                s = _host_snap(10 + i, 40, 1, 10 * (i + 1))
+                s["wall_time"] = float(i)
+                f.write(json.dumps(s) + "\n")
+            if torn:
+                f.write('{"graph": "g", "oper')       # died mid-write
+        with open(d / "events.jsonl", "w") as f:
+            f.write(json.dumps({"event": "eos", "wall": float(ticks)})
+                    + "\n")
+    merged, series, journal = dh.merge_monitoring_dirs(
+        [str(tmp_path / "ha"), str(tmp_path / "hb")])
+    assert merged["merged_from"] == 2
+    assert [h["host"] for h in merged["hosts"]] == ["ha", "hb"]
+    assert len(series) == 2                           # min(3, 2 whole lines)
+    assert merged["totals"]["inputs_received"] == 30 + 20
+    assert [e["event"] for e in journal] == ["eos", "eos"]
+
+
 def test_headroom_risk_flags():
     devs = [{"device": "tpu:0", "headroom_bytes": 5, "bytes_limit": 100},
             {"device": "tpu:1", "headroom_bytes": 50, "bytes_limit": 100},

@@ -1,0 +1,608 @@
+"""Fleet telemetry plane (observability/fleet.py + scripts/wf_fleet.py /
+wf_top.py): wire framing, the drop-oldest agent outbox, agent→aggregator
+loopback, the 3-host live-fleet acceptance loop (queue.stall chaos on ONE
+host driving the FLEET SLO OK→WARN→PAGE→OK with exactly one correlated
+fleet incident bundle), aggregator-death tick-cadence independence,
+telemetry-off hermeticity, WF117 validator pins, snapshot schema
+provenance, and the stdlib CLI exit contracts."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.nexmark import make_query
+from windflow_tpu.observability import (MonitoringConfig, device_health as
+                                        dh, fleet, metrics as metrics_mod,
+                                        names, slo as slomod)
+from windflow_tpu.runtime.pipeline import CompiledChain
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOST_DRIVER = os.path.join(REPO, "tests", "fleet_host_driver.py")
+WF_FLEET_CLI = os.path.join(REPO, "scripts", "wf_fleet.py")
+WF_TOP_CLI = os.path.join(REPO, "scripts", "wf_top.py")
+WF_SLO_CLI = os.path.join(REPO, "scripts", "wf_slo.py")
+
+LAT_SPEC = [{"name": "latency", "signal": "e2e_p99_ms", "target": 30.0,
+             "objective": 0.5, "fast_window": 3, "slow_window": 6,
+             "warn_burn": 1.0, "page_burn": 2.0}]
+
+
+def _poisoned_jax_dir(tmp_path):
+    d = tmp_path / "nojax"
+    d.mkdir(exist_ok=True)
+    (d / "jax.py").write_text("raise ImportError('fleet CLIs must not "
+                              "import jax')\n")
+    return str(d)
+
+
+def _snap(tick, host="h", graph="t", **over):
+    s = {"graph": graph, "schema": dh.SNAPSHOT_SCHEMA,
+         "wall_time": 1000.0 + tick, "uptime_s": float(tick),
+         "operators": [{"name": "map", "role": "map",
+                        "outputs_sent": 32 * (tick + 1),
+                        "service_time_us": {"p50": 10.0}}],
+         "totals": {"outputs_sent": 32 * (tick + 1)},
+         "e2e_latency_us": {"p50": 100.0, "p99": 200.0},
+         "queues": {"a->b": tick % 3}, "ordering": {}, "recovery": {},
+         "control": {"counters": {}}}
+    s.update(over)
+    return s
+
+
+# ------------------------------------------------------------ wire framing
+
+
+def test_frame_roundtrip():
+    frames = [{"kind": "snap", "host": f"h{i}", "snap": _snap(i)}
+              for i in range(3)]
+    dec = fleet.FrameDecoder()
+    out = dec.feed(b"".join(fleet.encode_frame(f) for f in frames))
+    assert out == frames
+    assert dec.frames_torn == 0 and dec.frames_decoded == 3
+
+
+def test_frame_split_feed():
+    """Byte-dribbled input (TCP segmentation) decodes identically."""
+    blob = fleet.encode_frame({"kind": "snap", "host": "h", "seq": 1})
+    dec = fleet.FrameDecoder()
+    out = []
+    for i in range(len(blob)):
+        out += dec.feed(blob[i:i + 1])
+    assert out == [{"kind": "snap", "host": "h", "seq": 1}]
+
+
+def test_frame_torn_resync():
+    """A torn frame (mid-write disconnect) is skipped at the next magic —
+    counted, never fatal, and the NEXT frame decodes."""
+    good = fleet.encode_frame({"kind": "snap", "host": "ok"})
+    dec = fleet.FrameDecoder()
+    out = dec.feed(b"garbage-prefix" + good[7:] + good)
+    assert [f["host"] for f in out] == ["ok"]
+    assert dec.frames_torn >= 1
+    # a corrupt length field resyncs too
+    dec2 = fleet.FrameDecoder()
+    bad = fleet.MAGIC + b"zzzzzzzz\n" + b"{}\n"
+    assert dec2.feed(bad + good) == [{"kind": "snap", "host": "ok"}]
+    assert dec2.frames_torn >= 1
+
+
+def test_frame_oversize_refused():
+    with pytest.raises(ValueError):
+        fleet.encode_frame({"blob": "x" * (fleet.MAX_FRAME_BYTES + 1)})
+
+
+@pytest.mark.parametrize("ep,want", [
+    ("tcp://127.0.0.1:9900", ("tcp", "127.0.0.1", 9900)),
+    ("127.0.0.1:0", ("tcp", "127.0.0.1", 0)),
+    ("tcp://[::1]:80", ("tcp", "::1", 80)),
+    ("unix:///tmp/x.sock", ("unix", "/tmp/x.sock")),
+    ("unix:/tmp/y.sock", ("unix", "/tmp/y.sock")),
+])
+def test_parse_endpoint(ep, want):
+    assert fleet.parse_endpoint(ep) == want
+
+
+@pytest.mark.parametrize("bad", ["", "nohost", "tcp://:12", "tcp://h:xx",
+                                 "tcp://h:99999", "unix://"])
+def test_parse_endpoint_rejects(bad):
+    with pytest.raises(ValueError):
+        fleet.parse_endpoint(bad)
+
+
+# ------------------------------------------------------------ agent outbox
+
+
+def test_outbox_drop_oldest():
+    """The outbox is a bounded drop-OLDEST deque: the reporter side never
+    blocks and the newest snapshot always survives."""
+    agent = fleet.TelemetryAgent("127.0.0.1:1", host="h", outbox=3)
+    # never start()ed: nothing drains, so offers age out of the deque
+    for i in range(5):
+        agent.offer(_snap(i))
+    st = agent.stats()
+    assert st["frames_dropped"] == 2
+    assert st["outbox_depth"] == 3
+    assert st["frames_sent"] == 0 and st["connected"] == 0
+    agent.close(flush_s=0.0)
+
+
+def test_agent_rejects_unhonorable_config():
+    """The WF117 problems raise at construction — loudly, the WF116/slo
+    model, never a silently dead plane."""
+    with pytest.raises(ValueError):
+        fleet.TelemetryAgent("127.0.0.1:1", host="h", outbox=0)
+    with pytest.raises(ValueError):
+        fleet.TelemetryAgent("not-an-endpoint", host="h")
+
+
+# ----------------------------------------------------- name registries
+
+
+def test_telemetry_gauge_names_lockstep():
+    assert set(names.TELEMETRY_GAUGES) == set(metrics_mod._TELEMETRY_HELP)
+    assert set(names.FLEET_GAUGES) == set(fleet._FLEET_HELP)
+
+
+def test_fleet_journal_events_registered():
+    for ev in ("telemetry_connect", "telemetry_lost", "fleet_host_join",
+               "fleet_host_leave"):
+        assert ev in names.JOURNAL_EVENTS, ev
+
+
+def test_snapshot_schema_stamp():
+    """Every registry snapshot carries the schema version — the merge
+    fold's provenance source."""
+    reg = metrics_mod.MetricsRegistry("t")
+    assert reg.snapshot()["schema"] == dh.SNAPSHOT_SCHEMA
+
+
+# ------------------------------------------------------- schema provenance
+
+
+def test_merge_flags_mixed_schema():
+    """A mixed-schema fleet is FLAGGED, never silently folded: the merged
+    view keeps the newest schema + the per-host map."""
+    a, b = _snap(1), _snap(1)
+    b["schema"] = dh.SNAPSHOT_SCHEMA + 1
+    out = dh.merge_snapshots([a, b], hosts=["h0", "h1"])
+    assert out["schema"] == dh.SNAPSHOT_SCHEMA + 1
+    assert out["schema_mismatch"] == {"h0": dh.SNAPSHOT_SCHEMA,
+                                      "h1": dh.SNAPSHOT_SCHEMA + 1}
+    same = dh.merge_snapshots([_snap(1), _snap(1)], hosts=["h0", "h1"])
+    assert "schema_mismatch" not in same
+    assert same["schema"] == dh.SNAPSHOT_SCHEMA
+
+
+# ------------------------------------------------------------ loopback
+
+
+def test_agent_aggregator_loopback(tmp_path):
+    """One agent, one aggregator, loopback TCP: frames land, the fleet dir
+    is Reporter-schema (load_snapshots/load_journal read it unchanged),
+    and nothing drops against a live aggregator."""
+    out = str(tmp_path / "fleet")
+    agg = fleet.FleetAggregator("127.0.0.1:0", out, max_skew_s=0.2)
+    agg.start()
+    agent = fleet.TelemetryAgent(agg.endpoint, host="h0", outbox=8)
+    agent.start()
+    try:
+        for i in range(5):
+            agent.offer(_snap(i))
+            time.sleep(0.05)
+        deadline = time.monotonic() + 5.0
+        while (agg.stats()["frames_received"] < 5
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+    finally:
+        st = agent.stats()
+        agent.close()
+        agg.stop()
+    assert st["frames_sent"] == 5 and st["frames_dropped"] == 0
+    assert st["connected"] == 1
+    latest, series = dh.load_snapshots(out)
+    assert latest["merged_from"] == 1
+    assert latest["fleet"]["frames_received"] == 5
+    assert latest["fleet"]["frames_torn"] == 0
+    assert latest["queues"]["a->b"] == 4 % 3
+    assert len(series) == agg.stats()["ticks"]
+    events = [e["event"] for e in dh.load_journal(out)]
+    assert "fleet_host_join" in events and "fleet_host_leave" in events
+    assert os.path.exists(os.path.join(out, "metrics.prom"))
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "windflow_fleet_hosts_seen" in prom
+    assert "windflow_fleet_frames_received" in prom
+
+
+def test_aggregator_survives_torn_and_garbage(tmp_path):
+    """A client that sends garbage then dies must not wedge the
+    aggregator; a subsequent well-formed host still aggregates."""
+    import socket as socket_mod
+    out = str(tmp_path / "fleet")
+    agg = fleet.FleetAggregator("127.0.0.1:0", out, max_skew_s=0.2)
+    agg.start()
+    try:
+        _, host, port = fleet.parse_endpoint(agg.endpoint)
+        sk = socket_mod.create_connection((host, port), timeout=2)
+        sk.sendall(b"NOT A FRAME AT ALL\n" * 4)
+        sk.close()
+        agent = fleet.TelemetryAgent(agg.endpoint, host="h0", outbox=8)
+        agent.start()
+        agent.offer(_snap(0))
+        deadline = time.monotonic() + 5.0
+        while (agg.stats()["frames_received"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        agent.close()
+    finally:
+        agg.stop()
+    assert agg.stats()["frames_received"] == 1
+    latest, _series = dh.load_snapshots(out)
+    assert latest["merged_from"] == 1
+
+
+# ------------------------------------------------- live-fleet acceptance
+
+
+def _spawn_host(endpoint, tag, mon, faults):
+    return subprocess.Popen(
+        [sys.executable, HOST_DRIVER, endpoint, tag, mon,
+         "1" if faults else "0"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_live_fleet_acceptance(tmp_path):
+    """THE fleet acceptance loop: 3 real host processes stream their
+    Reporter ticks to one in-test aggregator; queue.stall chaos on ONE
+    host drives the FLEET latency SLO OK→WARN→PAGE→OK over the merged
+    view; exactly one manifest-committed fleet bundle lands whose
+    correlation.json blames exactly that host; wf_slo.py honors its
+    1-on-burning / 0-after-recovery contract over the aggregator's own
+    artifacts; and no host drops a frame against a live aggregator."""
+    agg_dir = str(tmp_path / "fleet")
+    agg = fleet.FleetAggregator("127.0.0.1:0", agg_dir,
+                                specs=slomod.resolve_specs(LAT_SPEC),
+                                max_skew_s=0.3, cooldown_s=60.0)
+    agg.start()
+    procs = []
+    try:
+        for i in range(3):
+            procs.append(_spawn_host(agg.endpoint, f"host{i}",
+                                     str(tmp_path / f"mon{i}"), i == 0))
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        agg.stop()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-2000:]
+        ok = [ln for ln in out.splitlines() if ln.startswith("FLEET-HOST-OK")]
+        assert ok, out
+        fields = dict(kv.split("=") for kv in ok[0].split()[1:])
+        assert fields["rows"] == "420"          # chaos never loses a batch
+        assert fields["dropped"] == "0"         # live aggregator: no drops
+        assert int(fields["sent"]) >= 3
+
+    # the merged fleet SLO walked OK -> WARN -> PAGE -> OK
+    series = [json.loads(ln) for ln in
+              open(os.path.join(agg_dir, "snapshots.jsonl"))]
+    codes = [s.get("slo", {}).get("latency", {}).get("code")
+             for s in series]
+    walk = [c for i, c in enumerate(codes) if i == 0 or codes[i - 1] != c]
+    assert 2 in walk, walk                      # paged
+    assert walk[-1] == 0, walk                  # recovered
+    assert series[-1]["slo"]["latency"]["pages"] == 1
+    assert series[-1]["merged_from"] >= 1
+    assert series[-1]["fleet"]["hosts_seen"] == 3
+    assert series[-1]["fleet"]["frames_torn"] == 0
+
+    # exactly ONE committed fleet bundle, correlating exactly host0
+    bundles, torn = slomod.list_incidents(agg_dir)
+    assert len(bundles) == 1 and not torn
+    man = bundles[0]
+    assert man["slo"] == "latency" and not man.get("missing")
+    assert "correlation.json" in man["files"]
+    corr = json.load(open(os.path.join(man["path"], "correlation.json")))
+    assert corr["fleet_slo"] == "latency"
+    assert corr["worst_host"] == "host0"
+    by_host = {h["host"]: h for h in corr["hosts"]}
+    assert set(by_host) == {"host0", "host1", "host2"}
+    assert by_host["host0"]["correlated"] is True
+    assert by_host["host1"]["correlated"] is False
+    assert by_host["host2"]["correlated"] is False
+    # the fleet bundle POINTS at each host's own artifacts
+    assert by_host["host0"]["mon_dir"].endswith("mon0")
+
+    # host journal records were re-emitted host-tagged into the fleet
+    # events file: host0's page is visible at the fleet, named
+    fleet_events = dh.load_journal(agg_dir)
+    host_pages = [e for e in fleet_events
+                  if e.get("event") == "slo_page" and e.get("host")]
+    assert host_pages and all(e["host"] == "host0" for e in host_pages)
+    joins = {e.get("host") for e in fleet_events
+             if e.get("event") == "fleet_host_join"}
+    assert joins == {"host0", "host1", "host2"}
+
+    # wf_slo.py exit contract OVER THE AGGREGATOR DIR: the burn prefix
+    # (through the first PAGE tick) exits 1; the full recovered series
+    # exits 0 — the fleet dir is a plain monitoring dir to the CLI
+    first_page = codes.index(2)
+    prefix = tmp_path / "prefix"
+    prefix.mkdir()
+    with open(prefix / "snapshots.jsonl", "w") as f:
+        for s in series[:first_page + 1]:
+            f.write(json.dumps(s) + "\n")
+    specf = tmp_path / "spec.json"
+    specf.write_text(json.dumps(LAT_SPEC))
+    env = dict(os.environ, PYTHONPATH=_poisoned_jax_dir(tmp_path))
+    r = subprocess.run([sys.executable, WF_SLO_CLI, "--monitoring-dir",
+                        str(prefix), "--specs", str(specf)],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    r = subprocess.run([sys.executable, WF_SLO_CLI, "--monitoring-dir",
+                        agg_dir, "--specs", str(specf)],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # ... and the incident ledger renders the FLEET bundle
+    assert "correlation.json" not in r.stdout   # ledger names, not files
+    assert "latency" in r.stdout
+
+    # wf_top renders the aggregator dir (CI mode), fleet line included
+    r = subprocess.run([sys.executable, WF_TOP_CLI, "--monitoring-dir",
+                        agg_dir, "--once"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "fleet:" in r.stdout and "SLOs" in r.stdout
+
+
+def test_aggregator_death_leaves_tick_cadence_alone(tmp_path):
+    """Kill the aggregator mid-run: the host's Reporter keeps its cadence
+    (the offer is a deque append, never a socket wait), the run completes,
+    and the host's own artifacts land whole."""
+    agg_dir = str(tmp_path / "fleet")
+    mon = str(tmp_path / "mon")
+    agg = fleet.FleetAggregator("127.0.0.1:0", agg_dir, max_skew_s=0.3)
+    agg.start()
+    p = _spawn_host(agg.endpoint, "host0", mon, faults=True)
+    try:
+        deadline = time.monotonic() + 120.0
+        while (agg.stats()["frames_received"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert agg.stats()["frames_received"] >= 2
+    finally:
+        agg.stop()                       # mid-run kill
+    out, err = p.communicate(timeout=240)
+    assert p.returncode == 0, err[-2000:]
+    ok = [ln for ln in out.splitlines() if ln.startswith("FLEET-HOST-OK")]
+    assert ok and "rows=420" in ok[0]
+    # the host's own monitoring kept ticking after the aggregator died
+    snap = json.load(open(os.path.join(mon, "snapshot.json")))
+    host_series = [json.loads(ln) for ln in
+                   open(os.path.join(mon, "snapshots.jsonl"))]
+    assert len(host_series) >= 10       # the chaos phase alone spans ~50
+    tel = snap["telemetry"]
+    assert tel["frames_sent"] >= 2
+    # frames offered after the death were counted, never waited on
+    assert tel["frames_sent"] + tel["frames_dropped"] < len(host_series) + 2
+
+
+# ------------------------------------------------ off-path hermeticity
+
+
+def _run_q3(driver, monitoring=False):
+    src, ops = make_query("q3_enrich_join", 512)
+    rows = []
+
+    def cb(view):
+        if view is None:
+            return
+        rows.append((np.asarray(view["key"]).tolist(),
+                     np.asarray(view["id"]).tolist(),
+                     np.asarray(view["ts"]).tolist()))
+    sink = wf.Sink(cb)
+    if driver == "plain":
+        wf.Pipeline(src, ops, sink, batch_size=64,
+                    monitoring=monitoring).run()
+    else:
+        g = wf.PipeGraph(batch_size=64, monitoring=monitoring)
+        mp = g.add_source(src)
+        for op in ops:
+            mp.add(op)
+        mp.add_sink(sink)
+        if driver == "graph":
+            g.run()
+        elif driver == "graph-threaded":
+            g.run(threaded=True)
+        elif driver == "graph-supervised":
+            g.run_supervised(checkpoint_every=2, backoff_base=0.001,
+                             backoff_cap=0.01)
+    return rows
+
+
+@pytest.mark.parametrize("driver", ["plain", "graph", "graph-threaded",
+                                    "graph-supervised"])
+def test_telemetry_on_results_byte_identical(tmp_path, driver):
+    """telemetry= on (streaming to a LIVE loopback aggregator) must not
+    change a single result byte through any of the four drivers — the
+    plane is Reporter-thread work only."""
+    base = _run_q3(driver)
+    agg = fleet.FleetAggregator("127.0.0.1:0",
+                                str(tmp_path / f"fleet-{driver}"),
+                                max_skew_s=0.2)
+    agg.start()
+    try:
+        cfg = MonitoringConfig(out_dir=str(tmp_path / f"m-{driver}"),
+                               interval_s=30.0, telemetry=agg.endpoint)
+        on = _run_q3(driver, monitoring=cfg)
+    finally:
+        agg.stop()
+    assert on == base
+    # the run's final emit streamed at least one frame
+    snap = json.load(open(os.path.join(str(tmp_path / f"m-{driver}"),
+                                       "snapshot.json")))
+    assert "telemetry" in snap
+
+
+def test_off_path_hlo_identical(monkeypatch):
+    """WF_TELEMETRY contributes no equations: the lowered program is
+    textually identical with the env set vs not — the perf-gate pins
+    cannot move."""
+    def lowered_text():
+        src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=512,
+                        num_keys=4)
+        chain = CompiledChain([wf.Map(lambda t: {"v": t.v * 2})],
+                              src.payload_spec(), batch_capacity=64)
+        b = next(iter(src.batches(64)))
+        return chain._step_fn(0).lower(tuple(chain.states), b).as_text()
+    base = lowered_text()
+    monkeypatch.setenv("WF_MONITORING", "1")
+    monkeypatch.setenv("WF_TELEMETRY", "tcp://127.0.0.1:9")
+    assert lowered_text() == base
+
+
+# ------------------------------------------------------------ WF117 pins
+
+
+def _plain_pipeline(**kw):
+    src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=256,
+                    num_keys=4)
+    return wf.Pipeline(src, [wf.Map(lambda t: {"v": t.v})],
+                       wf.Sink(lambda v: None), batch_size=64, **kw)
+
+
+def test_wf117_env_on_monitoring_off(monkeypatch):
+    from windflow_tpu.analysis import validate
+    monkeypatch.setenv("WF_TELEMETRY", "1")
+    r = validate(_plain_pipeline())
+    assert "WF117" in r.codes() and r.errors
+    monkeypatch.setenv("WF_MONITORING", "1")
+    monkeypatch.setenv("WF_TELEMETRY_ENDPOINT", "127.0.0.1:9")
+    r = validate(_plain_pipeline())
+    assert "WF117" not in r.codes()
+
+
+@pytest.mark.parametrize("cfg_kw,frag", [
+    (dict(telemetry="not-an-endpoint"), "does not parse"),
+    (dict(telemetry=True), "does not parse"),     # True + no endpoint env
+    (dict(telemetry="127.0.0.1:9", telemetry_outbox=0), "outbox"),
+])
+def test_wf117_bad_configs(tmp_path, cfg_kw, frag):
+    from windflow_tpu.analysis import validate
+    cfg = MonitoringConfig(out_dir=str(tmp_path / "m"), **cfg_kw)
+    r = validate(_plain_pipeline(monitoring=cfg))
+    msgs = [d.message for d in r.diagnostics if d.code == "WF117"]
+    assert msgs and any(frag in m for m in msgs), msgs
+
+
+def test_wf117_in_explain_rules():
+    from windflow_tpu.analysis.lint import RULES
+    assert "WF117" in RULES and RULES["WF117"][0] == "error"
+
+
+def test_monitor_raises_on_unhonorable_telemetry(tmp_path):
+    """The runtime mirror of WF117: Monitor construction raises loudly
+    instead of starting a silently dead plane."""
+    from windflow_tpu.observability import Monitor
+    cfg = MonitoringConfig(out_dir=str(tmp_path / "m"),
+                           telemetry="not-an-endpoint")
+    with pytest.raises(ValueError):
+        Monitor(cfg)
+
+
+# ------------------------------------------------------------ CLI pins
+
+
+def test_wf_fleet_cli_contracts(tmp_path):
+    env = dict(os.environ, PYTHONPATH=_poisoned_jax_dir(tmp_path))
+    r = subprocess.run([sys.executable, WF_FLEET_CLI, "status",
+                        "--monitoring-dir", str(tmp_path / "nope")],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 2
+    assert "cannot load snapshots" in r.stderr
+    r = subprocess.run([sys.executable, WF_FLEET_CLI, "serve",
+                        "--listen", "not-an-endpoint",
+                        "--out", str(tmp_path / "f")],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 2
+    assert "bad --listen endpoint" in r.stderr
+    # the loopback selftest is the CI smoke: exit 0, artifacts land
+    out = str(tmp_path / "fleet")
+    r = subprocess.run([sys.executable, WF_FLEET_CLI, "selftest",
+                        "--out", out, "--ticks", "3"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+    r = subprocess.run([sys.executable, WF_FLEET_CLI, "status",
+                        "--monitoring-dir", out, "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    data = json.loads(r.stdout)
+    assert data["fleet"]["frames_torn"] == 0
+    assert data["merged_from"] == 2
+
+
+def test_wf_top_cli_contracts(tmp_path):
+    env = dict(os.environ, PYTHONPATH=_poisoned_jax_dir(tmp_path))
+    r = subprocess.run([sys.executable, WF_TOP_CLI, "--monitoring-dir",
+                        str(tmp_path / "nope"), "--once"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 2
+    assert "cannot load snapshots" in r.stderr
+    # renders a plain (non-fleet) monitoring dir too
+    mon = tmp_path / "m"
+    mon.mkdir()
+    with open(mon / "snapshots.jsonl", "w") as f:
+        for i in range(3):
+            f.write(json.dumps(_snap(i, over={})) + "\n")
+    r = subprocess.run([sys.executable, WF_TOP_CLI, "--monitoring-dir",
+                        str(mon), "--once"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "stages" in r.stdout and "queues" in r.stdout
+
+
+def test_wf_slo_merge_mode(tmp_path):
+    """--merge evaluates the spec set over the offline fleet fold with the
+    same exit contract, and flags mixed-schema hosts."""
+    env = dict(os.environ, PYTHONPATH=_poisoned_jax_dir(tmp_path))
+    dirs = []
+    for h, p99 in (("a", 200.0), ("b", 50e3)):   # host b burns
+        d = tmp_path / h
+        d.mkdir()
+        with open(d / "snapshots.jsonl", "w") as f:
+            for i in range(8):
+                s = _snap(i)
+                s["e2e_latency_us"] = {"p99": p99, "p99_tick": p99,
+                                       "samples": 8, "samples_tick": 8}
+                f.write(json.dumps(s) + "\n")
+        dirs.append(str(d))
+    specf = tmp_path / "spec.json"
+    specf.write_text(json.dumps(LAT_SPEC))
+    r = subprocess.run([sys.executable, WF_SLO_CLI, "--merge", *dirs,
+                        "--specs", str(specf)],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr   # merged view burns
+    assert "merged 2 host(s)" in r.stdout
+    # mixed schema across the merged hosts is flagged in the output
+    with open(tmp_path / "a" / "snapshots.jsonl") as f:
+        lines = [json.loads(ln) for ln in f]
+    for ln in lines:
+        ln.pop("schema", None)                      # seed-era host
+    with open(tmp_path / "a" / "snapshots.jsonl", "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    r = subprocess.run([sys.executable, WF_SLO_CLI, "--merge", *dirs,
+                        "--specs", str(specf), "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    data = json.loads(r.stdout)
+    assert data["schema_mismatch"] == {"a": 0, "b": dh.SNAPSHOT_SCHEMA}

@@ -57,6 +57,8 @@ Every function is classified by the set of ROLES it can run on:
 - ``checkpoint-pool`` — a sharded-checkpoint ``ThreadPoolExecutor`` worker
 - ``jax-callback``    — a JAX ``io_callback`` host-callback thread
 - ``prefetch``        — the double-buffered H2D ingest worker
+- ``telemetry``       — fleet telemetry plane threads (agent sender,
+  aggregator accept/reader/ticker)
 - ``native``          — short-lived native record-framing workers
 - ``thread``          — an UNANNOTATED spawned thread (unknown worker)
 
@@ -116,7 +118,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 # ------------------------------------------------------------------ grammar
 
 ROLES = ("driver", "stage", "reporter", "watchdog", "checkpoint-pool",
-         "jax-callback", "prefetch", "native", "thread")
+         "jax-callback", "prefetch", "telemetry", "native", "thread")
 
 #: default role a spawn seeds when the spawn line carries no annotation
 DEFAULT_THREAD_ROLE = "thread"

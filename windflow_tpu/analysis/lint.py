@@ -19,6 +19,13 @@ WF116  error     SLO config the run cannot honor (a validate()-time
                  slow_window``, objective outside (0, 1),
                  ``warn_burn > page_burn``) — fix hints name the
                  registered signals and the window contract
+WF117  error     telemetry config the run cannot honor (a
+                 validate()-time code, registered in RULES for
+                 --explain/--select): ``WF_TELEMETRY`` set while
+                 monitoring itself resolves off (the agent rides the
+                 Reporter tick — no frames could ever stream), an
+                 endpoint that does not parse (``tcp://HOST:PORT`` /
+                 ``unix:///path.sock``), or an outbox capacity < 1
 WF200  error     scanned file fails to parse (the linter cannot see it)
 WF201  error     ``WF_*`` env read missing from ``docs/ENV_FLAGS.md``
 WF202  error     ENV_FLAGS.md row does not state WHEN the flag is read
@@ -94,6 +101,10 @@ RULES: Dict[str, Tuple[str, str]] = {
     "WF116": ("error", "SLO config the run cannot honor (WF_SLO while "
                        "monitoring off, malformed spec set, unknown "
                        "signal name, fast_window >= slow_window)"),
+    # WF117 is likewise validate()-time (validate.py::_check_telemetry)
+    "WF117": ("error", "telemetry config the run cannot honor "
+                       "(WF_TELEMETRY while monitoring off, "
+                       "missing/unparseable endpoint, outbox < 1)"),
     "WF200": ("error", "scanned file fails to parse (the linter cannot "
                        "see it)"),
     "WF201": ("error", "WF_* env read missing from docs/ENV_FLAGS.md"),

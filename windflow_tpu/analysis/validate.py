@@ -78,6 +78,13 @@ WF116  error     SLO config the run cannot honor
                  unknown signal name, or per-spec geometry the burn
                  math rejects (``fast_window >= slow_window``,
                  objective outside (0, 1), ``warn_burn > page_burn``)
+WF117  error     telemetry config the run cannot honor
+                 (``observability/fleet.py``): the ``WF_TELEMETRY``
+                 sub-toggle set while monitoring itself resolves off
+                 (the agent rides the Reporter tick — no frames could
+                 ever stream), a telemetry endpoint that does not
+                 parse (``tcp://HOST:PORT`` / ``unix:///path.sock``),
+                 or an outbox capacity < 1 (cannot hold one frame)
 WF114  warn/err  tiered keyed state (``windflow_tpu/state``) combined
                  with a configuration its determinism/sizing contract
                  cannot honor: sequence-id tracing or wall-clock
@@ -709,6 +716,56 @@ def _check_slo(report, stored_monitoring) -> None:
         seen.add(spec.name)
 
 
+def _check_telemetry(report, stored_monitoring) -> None:
+    """WF117: the telemetry mirror of WF116 — resolve the monitoring config
+    exactly as the Monitor will and reject telemetry configurations the
+    agent cannot honor before the run starts (the TelemetryAgent raises the
+    same problems at Monitor construction; this surfaces them pre-run with
+    the operator-path/hint shape)."""
+    import os
+    from ..observability import MonitoringConfig
+    try:
+        cfg = MonitoringConfig.resolve(stored_monitoring)
+    except (ValueError, TypeError):
+        return                          # already diagnosed as WF113
+    if cfg is None:
+        env = os.environ.get("WF_TELEMETRY", "")
+        if env not in ("", "0"):
+            report.add(
+                "WF117", "error", "monitoring.telemetry",
+                "WF_TELEMETRY is set but monitoring itself resolves off — "
+                "the telemetry agent rides the Reporter tick, so no frames "
+                "can ever stream to the fleet aggregator",
+                hint="enable monitoring alongside the sub-toggle: "
+                     "WF_MONITORING=1 (or monitoring=/MonitoringConfig("
+                     "telemetry=...) on the driver)")
+        return
+    if cfg.telemetry in (False, None):
+        return
+    # the plane is on: the endpoint must parse and the outbox must hold
+    # at least one frame (fleet.py raises the identical ValueErrors at
+    # Monitor construction — WF117 is the pre-run surface of those)
+    from ..observability import fleet as _fleet
+    endpoint = (cfg.telemetry if isinstance(cfg.telemetry, str)
+                else os.environ.get("WF_TELEMETRY_ENDPOINT", ""))
+    try:
+        _fleet.parse_endpoint(endpoint)
+    except ValueError as e:
+        report.add(
+            "WF117", "error", "monitoring.telemetry",
+            f"telemetry endpoint does not parse: {e}",
+            hint="telemetry='tcp://HOST:PORT' / 'unix:///path.sock' (or "
+                 "telemetry=True + WF_TELEMETRY_ENDPOINT); the aggregator "
+                 "side is scripts/wf_fleet.py serve --listen <endpoint>")
+    if int(cfg.telemetry_outbox) < 1:
+        report.add(
+            "WF117", "error", "monitoring.telemetry",
+            f"telemetry_outbox={cfg.telemetry_outbox} cannot hold a single "
+            "frame — the agent's drop-oldest outbox needs capacity >= 1",
+            hint="telemetry_outbox/WF_TELEMETRY_OUTBOX must be a positive "
+                 "integer (default 64 ticks of backlog)")
+
+
 def _check_kernel_records(report) -> None:
     """WF109: compare every kernel-impl choice the registry recorded at
     trace time against what it would resolve to NOW (env/tuning-cache as of
@@ -1074,6 +1131,7 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _check_trace(report, trace, getattr(p, "_trace_arg", None), supervised)
     _check_health(report, getattr(p, "_monitoring_arg", None))
     _check_slo(report, getattr(p, "_monitoring_arg", None))
+    _check_telemetry(report, getattr(p, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(p, "_dispatch_arg", None), cfg,
                     trace, getattr(p, "_trace_arg", None), supervised)
 
@@ -1098,6 +1156,7 @@ def _validate_supervised(report, sp, faults, control, trace=None,
     _check_trace(report, trace, getattr(sp, "_trace_arg", None), True)
     _check_health(report, getattr(sp, "_monitoring_arg", None))
     _check_slo(report, getattr(sp, "_monitoring_arg", None))
+    _check_telemetry(report, getattr(sp, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(sp, "_dispatch_arg", None),
                     cfg, trace, getattr(sp, "_trace_arg", None), True)
     _check_shards(report,
@@ -1152,6 +1211,7 @@ def _validate_threaded(report, tp, faults, control, supervised,
     _check_trace(report, trace, getattr(tp, "_trace_arg", None), supervised)
     _check_health(report, getattr(tp, "_monitoring_arg", None))
     _check_slo(report, getattr(tp, "_monitoring_arg", None))
+    _check_telemetry(report, getattr(tp, "_monitoring_arg", None))
     _check_dispatch(report, dispatch, getattr(tp, "_dispatch_arg", None),
                     cfg, trace, getattr(tp, "_trace_arg", None), supervised,
                     edges=edges)
@@ -1264,6 +1324,7 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_trace(report, trace, getattr(g, "_trace_arg", None), supervised)
     _check_health(report, getattr(g, "_monitoring_arg", None))
     _check_slo(report, getattr(g, "_monitoring_arg", None))
+    _check_telemetry(report, getattr(g, "_monitoring_arg", None))
     dedges = None
     if threaded:
         try:

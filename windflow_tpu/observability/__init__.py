@@ -31,6 +31,10 @@ enabled per graph/pipeline via ``PipeGraph(..., monitoring=...)`` /
                                  # else JSON path/inline; see
                                  # MonitoringConfig.slo + slo.py)
     WF_SNAPSHOT_KEEP=500         # snapshots.jsonl keep-last-N retention
+    WF_TELEMETRY=tcp://agg:9901  # fleet telemetry sub-toggle ('1' = endpoint
+                                 # from WF_TELEMETRY_ENDPOINT, else the value
+                                 # IS the endpoint; see
+                                 # MonitoringConfig.telemetry + fleet.py)
 """
 
 from __future__ import annotations
@@ -142,6 +146,23 @@ class MonitoringConfig:
     #: thread.  Env override: ``WF_SNAPSHOT_KEEP`` (``''``/``'0'`` =
     #: unlimited).
     snapshot_keep: Optional[int] = None
+    #: fleet-telemetry sub-toggle (off by default): stream every Reporter
+    #: tick's snapshot + journal delta as length-framed JSON to a
+    #: ``FleetAggregator`` (``observability/fleet.py`` / ``scripts/
+    #: wf_fleet.py serve``) through a BOUNDED drop-oldest outbox — a slow
+    #: or dead aggregator costs frames (counted), never Reporter cadence.
+    #: Accepts ``True`` (endpoint from ``WF_TELEMETRY_ENDPOINT``) or an
+    #: endpoint string (``tcp://HOST:PORT`` / ``HOST:PORT`` /
+    #: ``unix://PATH``).  Host-side Reporter-thread work ONLY — compiled
+    #: programs, operator state, and the perf-gate pins are byte-for-byte
+    #: unchanged either way.  Env override: ``WF_TELEMETRY`` (``''``/
+    #: ``'0'`` off, ``'1'`` endpoint from WF_TELEMETRY_ENDPOINT, anything
+    #: else IS the endpoint); a missing/unparseable endpoint or an outbox
+    #: < 1 raises at Monitor construction and is WF117 in ``validate()``.
+    telemetry: object = False
+    #: bounded outbox depth between the Reporter tick and the telemetry
+    #: sender thread (``WF_TELEMETRY_OUTBOX``; must be >= 1 — WF117)
+    telemetry_outbox: int = 64
 
     def should_sample_e2e(self, n: int) -> bool:
         """THE e2e sampling policy, shared by every driver: every Nth source
@@ -198,6 +219,19 @@ class MonitoringConfig:
         if sk:
             cfg = dataclasses.replace(
                 cfg, snapshot_keep=(int(sk) if sk != "0" else None))
+        tv = os.environ.get("WF_TELEMETRY")
+        if tv is not None and tv != "":
+            cfg = dataclasses.replace(
+                cfg, telemetry=(False if tv == "0"
+                                else (True if tv == "1" else tv)))
+        te = os.environ.get("WF_TELEMETRY_ENDPOINT", "")
+        if te and cfg.telemetry is True:
+            # '1' (kwarg or env) defers the address to the endpoint var;
+            # an explicit endpoint string always wins
+            cfg = dataclasses.replace(cfg, telemetry=te)
+        tb = os.environ.get("WF_TELEMETRY_OUTBOX", "")
+        if tb:
+            cfg = dataclasses.replace(cfg, telemetry_outbox=int(tb))
         if cfg.snapshot_keep is not None and int(cfg.snapshot_keep) < 1:
             raise ValueError(
                 f"snapshot_keep/WF_SNAPSHOT_KEEP must be >= 1 (or unset "
@@ -208,6 +242,22 @@ class MonitoringConfig:
                 f"{cfg.health_sample} (the validator reports this as WF113 "
                 f"before the run)")
         return cfg
+
+
+def _telemetry_host_tag() -> str:
+    """The host tag telemetry frames carry — the aggregator's merge key.
+    ``WF_TELEMETRY_HOST`` (read at Monitor construction) overrides; else
+    the multihost harness's ``jax.process_index()`` (the 2proc convention),
+    falling back to the pid for processes without an initialized backend.
+    Resolved only when telemetry is ON — the off path never touches jax."""
+    tag = os.environ.get("WF_TELEMETRY_HOST", "")
+    if tag:
+        return tag
+    try:
+        import jax
+        return f"host{jax.process_index()}"
+    except Exception:  # noqa: BLE001 — no/broken backend: pid is still
+        return f"pid{os.getpid()}"          # unique on one box
 
 
 def event_time_enabled(monitoring=None) -> bool:
@@ -260,11 +310,29 @@ class Monitor:
                 max_incidents=config.slo_max_incidents,
                 journal_path=journal_path,
                 fingerprint=self._config_fingerprint)
+        #: fleet telemetry agent (MonitoringConfig.telemetry): constructed
+        #: here so a missing/unparseable endpoint or an outbox < 1 fails
+        #: the run loudly at Monitor construction (the SLO-engine
+        #: convention; validate() reports it as WF117 pre-run).  The
+        #: Reporter stamps its stats into every snapshot and offers the
+        #: written snapshot after each tick — never blocking (fleet.py)
+        self.telemetry = None
+        if config.telemetry not in (False, None):
+            from . import fleet
+            endpoint = (config.telemetry
+                        if isinstance(config.telemetry, str)
+                        else os.environ.get("WF_TELEMETRY_ENDPOINT", ""))
+            self.telemetry = fleet.TelemetryAgent(
+                endpoint, host=_telemetry_host_tag(),
+                out_dir=config.out_dir,
+                outbox=config.telemetry_outbox,
+                journal_path=journal_path, journal=self.journal)
         self.reporter = Reporter(self.registry, config.out_dir,
                                  interval_s=config.interval_s,
                                  prometheus=config.prometheus,
                                  slo_engine=self.slo,
-                                 snapshot_keep=config.snapshot_keep)
+                                 snapshot_keep=config.snapshot_keep,
+                                 telemetry_agent=self.telemetry)
         self._finished = False
 
     def _config_fingerprint(self) -> dict:
@@ -290,6 +358,8 @@ class Monitor:
                                interval_s=self.config.interval_s)
         if self.health is not None:
             device_health.set_active(self.health)
+        if self.telemetry is not None:
+            self.telemetry.start()
         self.reporter.start()
 
     def finish(self, target=None) -> None:
@@ -308,6 +378,10 @@ class Monitor:
                                        "topology.json"), "w") as f:
                     _json.dump(topology_json(target, snap), f, indent=1)
         finally:
+            if self.telemetry is not None:
+                # AFTER reporter.stop: the final emit's frame gets its
+                # best-effort flush window before the sender goes away
+                self.telemetry.close()
             if (self.health is not None
                     and device_health.get_active() is self.health):
                 device_health.set_active(None)

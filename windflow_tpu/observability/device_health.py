@@ -53,6 +53,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import journal as _journal
 
+#: snapshot schema version, stamped by ``MetricsRegistry.snapshot()`` as
+#: the top-level ``"schema"`` field.  Seed-era snapshots carry no field and
+#: read as version 0.  ``merge_snapshots`` never SILENTLY folds hosts that
+#: disagree — a heterogeneous fleet mid-upgrade gets a ``schema_mismatch``
+#: section that the loaders/CLIs surface.  Bump when a section's meaning
+#: (not mere presence — sections are already optional) changes.
+SNAPSHOT_SCHEMA = 1
+
 #: dispatch-bound classifier threshold: a stage whose host-dispatch overhead
 #: is at least this fraction of its device time is a fusion candidate (the
 #: host loop, not the chip, is its ceiling)
@@ -537,7 +545,19 @@ def merge_snapshots(snaps: Sequence[dict],
     snaps = [s for s in snaps if s]
     if not snaps:
         raise ValueError("merge_snapshots: no snapshots to merge")
-    hosts = list(hosts) if hosts else [f"host{i}" for i in range(len(snaps))]
+    hosts = list(hosts) if hosts else []
+    if len(hosts) < len(snaps):               # pad, never silently truncate
+        hosts += [f"host{i}" for i in range(len(hosts), len(snaps))]
+    # duplicate host tags (two --merge dirs with the same basename) are
+    # disambiguated with a #N suffix, never silently folded into one host's
+    # rows — host-tagged sections (shards, pages_by_host, devices) would
+    # otherwise collide and drop data
+    seen_tags: Dict[str, int] = {}
+    for i, h in enumerate(hosts):
+        n = seen_tags.get(h, 0) + 1
+        seen_tags[h] = n
+        if n > 1:
+            hosts[i] = f"{h}#{n}"
     out: dict = {
         "graph": "+".join(dict.fromkeys(s.get("graph", "?") for s in snaps)),
         "merged_from": len(snaps),
@@ -546,11 +566,23 @@ def merge_snapshots(snaps: Sequence[dict],
                    "uptime_s": s.get("uptime_s")}
                   for h, s in zip(hosts, snaps)],
     }
+    # schema provenance: the merged view carries the NEWEST schema seen;
+    # hosts that disagree (a fleet mid-upgrade) are flagged per host under
+    # ``schema_mismatch`` — the fold still runs (the sections are all
+    # individually optional), but the disagreement is never silent, and the
+    # loaders/CLIs surface it (seed-era snapshots read as version 0)
+    schemas = {h: int(s.get("schema", 0) or 0)
+               for h, s in zip(hosts, snaps)}
+    out["schema"] = max(schemas.values())
+    if len(set(schemas.values())) > 1:
+        out["schema_mismatch"] = schemas
     # operators joined by name: counters summed, percentiles max'd
     ops: Dict[str, dict] = {}
     order: List[str] = []
     for host, s in zip(hosts, snaps):
-        for row in s.get("operators", []):
+        for row in s.get("operators") or []:
+            if not isinstance(row, dict):
+                continue                      # torn/partial host section
             name = row.get("name", "?")
             dst = ops.get(name)
             if dst is None:
@@ -601,7 +633,7 @@ def merge_snapshots(snaps: Sequence[dict],
         out["e2e_latency_us"] = e2e
     # graph-level event time: the fleet frontier is the MIN across hosts
     ets = [(h, s.get("event_time")) for h, s in zip(hosts, snaps)
-           if s.get("event_time")]
+           if isinstance(s.get("event_time"), dict)]
     if ets:
         sec: dict = {}
         wm = [(e["min_watermark_ts"], h, e) for h, e in ets
@@ -623,7 +655,7 @@ def merge_snapshots(snaps: Sequence[dict],
     # gauges could not name WHICH shard is hot, which is the whole point
     # of the per-shard health surface (names.py::SHARD_GAUGES)
     shard_secs = [(h, s.get("shards")) for h, s in zip(hosts, snaps)
-                  if s.get("shards")]
+                  if isinstance(s.get("shards"), dict)]
     if shard_secs:
         ssec: dict = {}
         for host, rows in shard_secs:
@@ -639,12 +671,14 @@ def merge_snapshots(snaps: Sequence[dict],
     # HEALTHIEST host's headroom on a row whose state says another host
     # is paging
     slo_secs = [(h, s.get("slo")) for h, s in zip(hosts, snaps)
-                if s.get("slo")]
+                if isinstance(s.get("slo"), dict)]
     if slo_secs:
         ssec: Dict[str, dict] = {}
         worst_key: Dict[str, tuple] = {}
         for host, rows in slo_secs:
             for name, row in rows.items():
+                if not isinstance(row, dict):
+                    continue                  # torn/partial host section
                 dst = ssec.setdefault(name, {"state": "ok", "code": 0,
                                              "pages": 0,
                                              "pages_by_host": {}})
@@ -676,7 +710,7 @@ def merge_snapshots(snaps: Sequence[dict],
     # compile counters summed, device-time summed with the dispatch-bound
     # classifier recomputed over the fleet totals
     healths = [(h, s.get("health")) for h, s in zip(hosts, snaps)
-               if s.get("health")]
+               if isinstance(s.get("health"), dict)]
     if healths:
         hsec: dict = {"devices": []}
         state_bytes: dict = {}

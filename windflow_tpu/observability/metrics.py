@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .device_health import SNAPSHOT_SCHEMA as _SNAPSHOT_SCHEMA
+
 #: histogram geometry: bounds[i] = BASE_S * GROWTH**i, spanning 1 us .. ~90 s
 _BASE_S = 1e-6
 _GROWTH = 2.0 ** 0.5
@@ -256,6 +258,34 @@ def _check_slo_names() -> None:
 
 
 _check_slo_names()
+
+#: HELP text per telemetry-agent gauge — checked against
+#: ``names.py::TELEMETRY_GAUGES`` at import (the SLO lockstep discipline).
+#: Rendered as ``windflow_telemetry_<name>{graph}`` from the snapshot's
+#: ``telemetry`` section (the TelemetryAgent stats the Reporter stamps in
+#: when ``MonitoringConfig.telemetry`` is on — absent otherwise, so the
+#: off path's artifacts are byte-identical).
+_TELEMETRY_HELP = {
+    "frames_sent": "telemetry frames delivered to the aggregator socket",
+    "frames_dropped": "telemetry frames evicted by the bounded drop-oldest "
+                      "outbox (a slow/dead aggregator costs frames, never "
+                      "Reporter cadence)",
+    "reconnects": "successful reconnects after a lost aggregator",
+    "outbox_depth": "telemetry frames queued right now",
+    "connected": "1 = live aggregator connection, 0 = not",
+}
+
+
+def _check_telemetry_names() -> None:
+    from .names import TELEMETRY_GAUGES
+    if set(_TELEMETRY_HELP) != set(TELEMETRY_GAUGES):
+        raise RuntimeError(
+            f"metrics.py telemetry exposition drifted from "
+            f"names.py::TELEMETRY_GAUGES: "
+            f"{set(_TELEMETRY_HELP) ^ set(TELEMETRY_GAUGES)}")
+
+
+_check_telemetry_names()
 
 
 def _recovery_counters() -> Dict[str, float]:
@@ -591,6 +621,10 @@ class MetricsRegistry:
         self._e2e_prev_counts = counts
         snap = {
             "graph": self.name,
+            # snapshot schema version (device_health.SNAPSHOT_SCHEMA):
+            # merge_snapshots refuses to SILENTLY fold hosts that disagree
+            # (a heterogeneous fleet mid-upgrade must be detectable)
+            "schema": _SNAPSHOT_SCHEMA,
             "wall_time": time.time(),
             "uptime_s": round(now - self.created, 3),
             "operators": ops_out,
@@ -810,6 +844,27 @@ class MetricsRegistry:
                 lines.append(f'windflow_slo_state{{{lab}}} {row["code"]}')
 
     @staticmethod
+    def _prometheus_telemetry(snap: dict, lines: List[str], esc) -> None:
+        """``windflow_telemetry_*`` gauges from the snapshot's ``telemetry``
+        section (the TelemetryAgent stats — present only when the fleet
+        telemetry plane is on).  Only the names registered in
+        ``names.py::TELEMETRY_GAUGES`` render (the import-time lockstep
+        check above)."""
+        sec = snap.get("telemetry")
+        if not sec:
+            return
+        g = snap["graph"]
+        for name in sorted(_TELEMETRY_HELP):
+            v = sec.get(name)
+            if v is None:
+                continue
+            lines.append(f"# HELP windflow_telemetry_{name} "
+                         f"{_TELEMETRY_HELP[name]}")
+            lines.append(f"# TYPE windflow_telemetry_{name} gauge")
+            lines.append(f'windflow_telemetry_{name}{{graph="{esc(g)}"}} '
+                         f'{v}')
+
+    @staticmethod
     def _prometheus_event_time(snap: dict, lines: List[str], esc) -> None:
         """``windflow_event_time_*`` gauges (HELP/TYPE'd) from the snapshot's
         event-time sections: per-operator watermark/lag/occupancy/pressure,
@@ -926,6 +981,7 @@ class MetricsRegistry:
         self._prometheus_event_time(snap, lines, esc)
         self._prometheus_health(snap, lines, esc)
         self._prometheus_slo(snap, lines, esc)
+        self._prometheus_telemetry(snap, lines, esc)
         lines.append("# TYPE windflow_queue_depth gauge")
         for edge, depth in snap["queues"].items():
             lines.append(f'windflow_queue_depth{{graph="{esc(g)}",'

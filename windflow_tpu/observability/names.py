@@ -81,6 +81,14 @@ JOURNAL_EVENTS = (
     # capture follows, rate-limited); "slo_recover" = a warned/paged SLO
     # returned to OK (from_state says which)
     "slo_page", "slo_recover",
+    # fleet telemetry plane (observability/fleet.py):
+    # "telemetry_connect"/"telemetry_lost" = the host agent's sender thread
+    # (re)gained / dropped its aggregator connection (host/endpoint) — a
+    # flapping link shows as a connect/lost train in the HOST journal;
+    # "fleet_host_join"/"fleet_host_leave" = the AGGREGATOR saw a new host
+    # tag's first frame / a host stream close (host, mon_dir on join)
+    "telemetry_connect", "telemetry_lost",
+    "fleet_host_join", "fleet_host_leave",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -232,6 +240,34 @@ SLO_GAUGES = (
     "signal",           # latest observed signal value
     "target",           # the spec's target threshold
     "pages",            # PAGE transitions this run
+)
+
+#: gauges of the host-side ``telemetry`` snapshot section
+#: (``observability/fleet.py`` TelemetryAgent.stats(), present only when
+#: ``MonitoringConfig.telemetry`` is on; ``metrics.py::
+#: _prometheus_telemetry`` renders ONLY registered names as
+#: ``windflow_telemetry_<name>{graph=...}`` — its local HELP map is checked
+#: against this tuple at import, the SLO_GAUGES lockstep discipline)
+TELEMETRY_GAUGES = (
+    "frames_sent",      # frames delivered to the aggregator socket
+    "frames_dropped",   # frames evicted by the bounded drop-oldest outbox
+    "reconnects",       # successful reconnects after a lost aggregator
+    "outbox_depth",     # frames queued right now (bounded by the outbox)
+    "connected",        # 1 = live aggregator connection, 0 = not
+)
+
+#: gauges of the aggregator-side ``fleet`` snapshot section
+#: (``observability/fleet.py`` FleetAggregator, stamped into every merged
+#: fleet snapshot and rendered as ``windflow_fleet_<name>{graph=...}`` by
+#: ``fleet.render_prometheus`` — ``fleet._FLEET_HELP`` is pinned against
+#: this tuple by ``tests/test_fleet.py``, the path-loadable analogue of the
+#: import-time lockstep check)
+FLEET_GAUGES = (
+    "hosts_connected",  # hosts with a live telemetry stream right now
+    "hosts_seen",       # distinct host tags seen since the serve started
+    "frames_received",  # telemetry frames decoded across all hosts
+    "frames_torn",      # frames lost to torn/corrupt wire data (resync'd)
+    "ticks",            # fleet merge ticks emitted
 )
 
 #: kernel families selectable through the per-backend kernel registry

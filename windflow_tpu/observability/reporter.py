@@ -51,11 +51,19 @@ def _atomic_write(path: str, data: str) -> None:
 class Reporter:
     def __init__(self, registry: MetricsRegistry, out_dir: str,
                  interval_s: float = 1.0, prometheus: bool = True,
-                 slo_engine=None, snapshot_keep: Optional[int] = None):
+                 slo_engine=None, snapshot_keep: Optional[int] = None,
+                 telemetry_agent=None):
         self.registry = registry
         self.out_dir = out_dir
         self.interval_s = max(0.05, float(interval_s))
         self.prometheus = prometheus
+        #: fleet telemetry agent (observability/fleet.py TelemetryAgent,
+        #: None = plane off): its stats are stamped into every snapshot
+        #: BEFORE the files land (so the artifacts carry the
+        #: windflow_telemetry_* gauges) and the written snapshot is OFFERED
+        #: after — a bounded deque append, never a socket wait, so the tick
+        #: cadence is independent of the aggregator's health by construction
+        self.telemetry = telemetry_agent
         #: SLO engine (observability/slo.py) evaluated INSIDE every tick,
         #: right after the registry snapshot and before the files land —
         #: the written snapshot.json/snapshots.jsonl carry its "slo"
@@ -117,6 +125,8 @@ class Reporter:
 
     def emit(self) -> dict:
         snap = self.registry.snapshot()
+        if self.telemetry is not None:
+            snap["telemetry"] = self.telemetry.stats()
         if self.slo is not None:
             try:
                 self.slo.observe(snap)
@@ -153,6 +163,11 @@ class Reporter:
         if self.prometheus:
             _atomic_write(os.path.join(self.out_dir, "metrics.prom"),
                           self.registry.to_prometheus(snap))
+        if self.telemetry is not None:
+            try:
+                self.telemetry.offer(snap)
+            except Exception:  # noqa: BLE001 — the telemetry plane is
+                pass           # best-effort; it must never cost a tick
         self.ticks += 1
         return snap
 

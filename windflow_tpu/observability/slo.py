@@ -433,6 +433,12 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
         self.incidents_captured = 0
         self.incidents_suppressed = 0
         self._last_capture: Optional[float] = None
+        #: when set (an EventJournal), transition events go to THIS journal
+        #: instead of the process-global active one — the fleet aggregator
+        #: runs an engine over the MERGED view inside a process that may
+        #: also be a monitored host, and its fleet pages must land in the
+        #: fleet events.jsonl, never the co-resident host's
+        self.journal_sink = None
 
     # -- evaluation --------------------------------------------------------
 
@@ -481,15 +487,21 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
         if st.state == STATE_PAGE:
             st.pages += 1
             if self.journal:
-                _journal.record("slo_page", slo=spec.name,
-                                signal=spec.signal, value=st.last_value,
-                                target=spec.target, burn_fast=st.burn_fast,
-                                burn_slow=st.burn_slow, tick=self._tick)
+                self._record("slo_page", slo=spec.name,
+                             signal=spec.signal, value=st.last_value,
+                             target=spec.target, burn_fast=st.burn_fast,
+                             burn_slow=st.burn_slow, tick=self._tick)
             self._maybe_capture(st, snap)
         elif st.state == STATE_OK and self.journal:
-            _journal.record("slo_recover", slo=spec.name,
-                            from_state=before, burn_fast=st.burn_fast,
-                            burn_slow=st.burn_slow, tick=self._tick)
+            self._record("slo_recover", slo=spec.name,
+                         from_state=before, burn_fast=st.burn_fast,
+                         burn_slow=st.burn_slow, tick=self._tick)
+
+    def _record(self, name: str, **fields) -> None:
+        if self.journal_sink is not None:
+            self.journal_sink.event(name, **fields)
+        else:
+            _journal.record(name, **fields)
 
     def report(self) -> Dict[str, dict]:
         """Whole-run summary per SLO (the offline CLI's data model): the
@@ -574,6 +586,8 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
         if chrome is not None:
             put("trace.json", chrome)
         put("config.json", self._config_fingerprint())
+        for fname, data in sorted(self._extra_bundle_files(st, snap).items()):
+            put(fname, data)
         # manifest LAST — the commit point
         _atomic_write(os.path.join(d, "manifest.json"), json.dumps({
             "schema": 1, "slo": st.spec.name, "signal": st.spec.signal,
@@ -583,6 +597,14 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
             "wall": time.time(), "files": files,
         }, indent=1, sort_keys=True))
         return d
+
+    def _extra_bundle_files(self, st: _SLOState, snap: dict) -> dict:
+        """Subclass hook: extra ``{filename: json-serializable}`` artifacts
+        committed into the bundle BEFORE the manifest (so the manifest's
+        ``files`` list covers them).  The base engine adds none; the fleet
+        aggregator's engine adds ``correlation.json`` (which hosts paged in
+        the same window — ``observability/fleet.py``)."""
+        return {}
 
     def _journal_tail(self) -> Optional[str]:
         if not self.journal_path or not os.path.exists(self.journal_path):
