@@ -29,10 +29,21 @@ invariance AND shard-local recovery byte-identity at once. The sharded
 pipeline run additionally carries a mid-stream N -> 2N live reshard.
 (--shards excludes --dispatch on the supervised drivers: WF115.)
 
+--remediate closes the loop: the supervised PIPELINE runs (baseline AND
+chaos) carry barrier remediation (``remediation=True`` + deterministic
+positional admission) — decisions are part of the replayed stream, so the
+faulted remediated runs must match the remediated baseline byte-for-byte.
+It then adds one LIVE threaded leg under queue.stall chaos riding the full
+self-driving loop — OK -> PAGE (drop_ratio burn) -> shed_harder actuation ->
+recovery back to OK — asserting the loop shape and that the incident bundle
+recorded the actions (lossy by design: admission sheds, so THIS leg asserts
+recovery, not byte-identity).
+
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --total 400
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --controller
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --dispatch 4
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --shards 4
+    JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 3 --remediate
 """
 
 import argparse
@@ -81,7 +92,7 @@ def collect(acc):
 
 
 def run_pipeline(total, batch, faults=None, controller=False, dispatch=False,
-                 shards=0):
+                 shards=0, remediate=False):
     got = []
     src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
                     total=total, num_keys=4)
@@ -98,7 +109,13 @@ def run_pipeline(total, batch, faults=None, controller=False, dispatch=False,
                        reshard=({"new_shards": shards * 2,
                                  "at_pos": max(1, total // batch // 3)}
                                 if shards else False),
-                       control=sup_control(batch) if controller else False
+                       # --remediate: barrier remediation over the owned
+                       # actuators (admission always; reshard when sharded)
+                       # — decisions are replayed state, so byte-identity
+                       # against the remediated baseline still holds
+                       remediation=True if remediate else None,
+                       control=(sup_control(batch)
+                                if (controller or remediate) else False)
                        ).run()
     return sorted(got)
 
@@ -155,6 +172,92 @@ def run_threaded(total, batch, faults=None, controller=False,
     return sorted(got)
 
 
+def run_closed_loop(seed):
+    """The headline --remediate acceptance: a LIVE threaded run under
+    queue.stall chaos rides the full self-driving loop — OK -> PAGE
+    (drop_ratio burn) -> shed_harder actuation -> recovery back to OK —
+    with the incident bundle recording the actions the page triggered.
+    Lossy by design (admission sheds during the flood), so this leg
+    asserts the loop shape, not byte-identity.  Returns (problems,
+    n_applies, n_faults)."""
+    import json
+    import shutil
+    import tempfile
+
+    from windflow_tpu.control import RemediationAction, RemediationPolicy
+    from windflow_tpu.observability import MonitoringConfig
+
+    mon_dir = tempfile.mkdtemp(prefix="wf_chaos_remediate_")
+    batch, total = 32, 6000
+    got = []
+
+    def sink(view):
+        # host-side pacing (the sink is a plain callback, never traced):
+        # ~4ms/batch keeps the run alive long past the bounded stall burst,
+        # so the burn windows get clean post-incident ticks to decay over
+        if view is not None:
+            got.extend(view["id"].tolist())
+        time.sleep(0.004)
+
+    # the admission rate is astronomically high: shed_harder's actuation is
+    # REAL (the setpoint halves, journaled, gauged) but never actually
+    # sheds, so the closed-loop leg also asserts zero tuple loss
+    policy = RemediationPolicy((RemediationAction(
+        name="shed_harder", slo="latency", actuator="admission_rate",
+        factor=0.5, floor=1.0, window=2, max_applies=2),))
+    mon = MonitoringConfig(
+        slo=json.dumps([{"name": "latency", "signal": "e2e_p99_ms",
+                         "target": 150.0, "objective": 0.5,
+                         "fast_window": 2, "slow_window": 4,
+                         "warn_burn": 0.5, "page_burn": 1.0}]),
+        remediation=policy, interval_s=0.05, remediation_cooldown_s=0.05,
+        out_dir=mon_dir)
+    # a bounded burst of queue stalls: each holds a ring op ~0.5s, so the
+    # delayed batches blow the per-tick e2e p99 past target (OK -> PAGE);
+    # max_fires bounds the incident, so the tail of the run recovers
+    inj = FaultInjector(FaultPlan([FaultSpec("queue.stall", kind="stall",
+                                             p=0.25, stall_s=0.5,
+                                             max_fires=4)], seed=seed))
+    src = wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+                    total=total, num_keys=4)
+    ThreadedPipeline(src, [[wf.Map(lambda t: {"v": t.v + 1.0})]],
+                     wf.Sink(sink),
+                     batch_size=batch, pin=False, heartbeat_timeout=0.25,
+                     faults=inj,
+                     control=ControlConfig(autotune=False,
+                                           backpressure=False,
+                                           admission=True, rate_tps=1e9),
+                     monitoring=mon).run()
+
+    snaps = [json.loads(line)
+             for line in open(os.path.join(mon_dir, "snapshots.jsonl"))]
+    events = [json.loads(line)
+              for line in open(os.path.join(mon_dir, "events.jsonl"))]
+    applies = [e for e in events if e.get("event") == "remediation_apply"]
+    paged = any((s.get("slo") or {}).get("latency", {}).get("state")
+                == "page" for s in snaps)
+    final = (snaps[-1].get("slo") or {}).get("latency", {}).get("state")
+    inc_dir = os.path.join(mon_dir, "incidents")
+    bundles = sorted(os.listdir(inc_dir)) if os.path.isdir(inc_dir) else []
+    with_rem = [b for b in bundles if os.path.exists(
+        os.path.join(inc_dir, b, "remediation.json"))]
+    problems = []
+    if not paged:
+        problems.append("the latency SLO never paged")
+    if not applies:
+        problems.append("no remediation_apply journaled")
+    if final != "ok":
+        problems.append(f"final state {final!r} — did not recover to ok")
+    if not bundles:
+        problems.append("no incident bundle captured for the page")
+    elif not with_rem:
+        problems.append("no incident bundle recorded remediation.json")
+    if sorted(got) != list(range(total)):
+        problems.append(f"tuple loss: {len(got)}/{total} delivered")
+    shutil.rmtree(mon_dir, ignore_errors=True)
+    return problems, len(applies), len(inj.fired)
+
+
 def plan_for(seed, threaded=False, shards=0):
     if threaded:
         # the threaded driver has no replay machinery: stalls only (delay,
@@ -197,6 +300,13 @@ def main():
                     "baselines stay unsharded, so every seed asserts "
                     "shard-count invariance and shard-local recovery at "
                     "once")
+    ap.add_argument("--remediate", action="store_true",
+                    help="supervised pipeline runs (baselines AND chaos) "
+                    "carry barrier remediation + deterministic admission "
+                    "(byte-identity must still hold), plus one live "
+                    "threaded closed-loop leg under queue.stall asserting "
+                    "OK -> PAGE -> actuate -> recovery to OK with the "
+                    "incident bundle recording the actions")
     args = ap.parse_args()
     if args.shards and args.dispatch:
         ap.error("--shards excludes --dispatch on the supervised drivers "
@@ -210,8 +320,10 @@ def main():
     baselines = {}
     for name, fn in drivers.items():
         t0 = time.time()
+        kw = ({"remediate": True}
+              if (args.remediate and name == "pipeline") else {})
         baselines[name] = fn(args.total, args.batch,
-                             controller=args.controller)
+                             controller=args.controller, **kw)
         print(f"[baseline] {name}: {len(baselines[name])} results "
               f"({time.time() - t0:.1f}s)")
 
@@ -224,6 +336,8 @@ def main():
             t0 = time.time()
             try:
                 kw = {"shards": n_shards} if n_shards else {}
+                if args.remediate and name == "pipeline":
+                    kw["remediate"] = True
                 out = fn(args.total, args.batch, faults=inj,
                          controller=args.controller,
                          dispatch=args.dispatch,   # 0 = off (every driver)
@@ -241,6 +355,17 @@ def main():
                 missing = set(baselines[name]) - set(out)
                 extra = set(out) - set(baselines[name])
                 print(f"            missing={len(missing)} extra={len(extra)}")
+    if args.remediate:
+        t0 = time.time()
+        problems, n_applies, n_faults = run_closed_loop(seed=0)
+        ok = not problems
+        print(f"[closed-loop] threaded: {n_faults} faults injected, "
+              f"{n_applies} remediation action(s), "
+              f"{'OK' if ok else 'FAILED'} ({time.time() - t0:.1f}s)")
+        if not ok:
+            for p in problems:
+                print(f"            {p}")
+            divergences += 1
     ctr = faults_mod.counters()
     print(f"\ncounters: {ctr}")
     if divergences:

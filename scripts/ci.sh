@@ -140,6 +140,33 @@ spec = [{"name": "lat", "signal": "e2e_p99_ms", "target": 10.0,
          "objective": 0.5, "fast_window": 2, "slow_window": 4}]
 with open(os.path.join(tmp, "spec.json"), "w") as f:
     json.dump(spec, f)
+# remediation ledger artifacts for the recovered dir: the engine section on
+# the final snapshot + apply/skip journal events — wf_slo's remediation
+# section must render them WITHOUT changing the 0/1 exit contract
+rec = os.path.join(tmp, "recovered")
+snaps = [json.loads(l) for l in open(os.path.join(rec, "snapshots.jsonl"))]
+snaps[-1]["remediation"] = {
+    "enabled": True, "applied": 1, "skipped": 1,
+    "bound": ["admission_rate"], "actions": ["shed_harder"],
+    "ledger": [{"action": "shed_harder", "actuator": "admission_rate",
+                "slo": "lat", "burn": 2.0, "applied": True,
+                "rate": 250.0, "prev_rate": 500.0}]}
+with open(os.path.join(rec, "snapshots.jsonl"), "w") as f:
+    for s in snaps:
+        f.write(json.dumps(s) + "\n")
+with open(os.path.join(rec, "events.jsonl"), "w") as f:
+    f.write(json.dumps({"t": 1.0, "wall": 1.0,
+                        "event": "remediation_apply",
+                        "action": "shed_harder",
+                        "actuator": "admission_rate", "slo": "lat",
+                        "burn": 2.0, "applied": True, "rate": 250.0,
+                        "prev_rate": 500.0}) + "\n")
+    f.write(json.dumps({"t": 2.0, "wall": 2.0,
+                        "event": "remediation_skip",
+                        "action": "shed_harder",
+                        "actuator": "admission_rate", "slo": "lat",
+                        "burn": 1.9, "applied": False,
+                        "reason": "damped"}) + "\n")
 PY
     PYTHONPATH="$tmp" python scripts/wf_slo.py \
         --monitoring-dir "$tmp/burning" --specs "$tmp/spec.json" \
@@ -157,10 +184,45 @@ PY
         echo "ci: wf_slo.py recovered contract broke (rc=${rc}, want 0)" >&2
         rm -rf "$tmp"; return 1
     fi
+    # remediation-section pins: the ledger renders (APPLY row + skip
+    # reason), shows up in --json, and does NOT perturb the exit contract
+    # (recovered stays 0) — still under the poisoned-jax PYTHONPATH
+    local remout
+    remout=$(PYTHONPATH="$tmp" python scripts/wf_slo.py \
+        --monitoring-dir "$tmp/recovered" --specs "$tmp/spec.json" \
+        --report remediation 2>&1)
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_slo.py remediation-section exit contract broke" \
+             "(rc=${rc}, want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    if ! printf '%s' "$remout" | grep -q "APPLY" \
+        || ! printf '%s' "$remout" | grep -q "reason=damped"; then
+        echo "ci: wf_slo.py remediation section did not render the" \
+             "apply/skip ledger" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_slo.py \
+        --monitoring-dir "$tmp/recovered" --specs "$tmp/spec.json" --json \
+        2>/dev/null | python -c '
+import json, sys
+d = json.load(sys.stdin)
+rem = d["remediation"]
+assert rem["recorded"]["applied"] == 1, rem
+assert [e["event"] for e in rem["events"]] == \
+    ["remediation_apply", "remediation_skip"], rem
+'
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_slo.py --json remediation payload broke (rc=${rc})" >&2
+        rm -rf "$tmp"; return 1
+    fi
     rm -rf "$tmp"
-    echo "stdlib CLI exit contracts ok (wf_slo 0/1/2, wf_state/wf_health/"
-    echo "wf_trace/wf_fleet/wf_top 2 on missing inputs, fleet loopback"
-    echo "selftest + wf_top/wf_slo over the aggregator dir; all without jax)"
+    echo "stdlib CLI exit contracts ok (wf_slo 0/1/2 + remediation ledger,"
+    echo "wf_state/wf_health/wf_trace/wf_fleet/wf_top 2 on missing inputs,"
+    echo "fleet loopback selftest + wf_top/wf_slo over the aggregator dir;"
+    echo "all without jax)"
 }
 run_step "stdlib CLIs" stdlib_cli_contracts
 

@@ -15,7 +15,12 @@ runs — ``observability/slo.py::evaluate_series``) and renders:
   (a crash mid-capture — the manifest is the commit point, so a torn bundle
   never half-parses);
 - any SLO sections the snapshots RECORDED live (the engine's own verdicts,
-  when the run had ``slo=`` on).
+  when the run had ``slo=`` on);
+- the **remediation ledger**: every ``remediation_apply`` /
+  ``remediation_skip`` journal event joined against the burn table (which
+  SLO the action served and what state that SLO ended the window in), plus
+  the engine's recorded snapshot state — the self-driving loop's audit trail
+  when the run had ``remediation=``/``WF_REMEDIATION`` on.
 
 Spec source precedence: ``--specs`` (JSON file path or inline JSON) >
 ``WF_SLO`` env (same forms) > the built-in default spec set.
@@ -132,6 +137,61 @@ def recorded_section(series):
     return lines
 
 
+def remediation_events(events):
+    """The remediation ledger rows out of a journal event list (live
+    Reporter-tick applies AND supervised commit-barrier applies share the
+    two event names)."""
+    return [e for e in events
+            if e.get("event") in ("remediation_apply", "remediation_skip")]
+
+
+def remediation_section(report, series, events):
+    """Action timeline joined to the burn table: what the remediation layer
+    did (or declined to do, and why) against each SLO's final state."""
+    lines = ["== remediation =="]
+    rows = remediation_events(events)
+    recorded = next(
+        (s.get("remediation") for s in reversed(series)
+         if s.get("remediation")), None)
+    if not rows and not recorded:
+        lines.append("  (no remediation activity recorded — enable with "
+                     "remediation=/WF_REMEDIATION=1 on a run with slo= on)")
+        return lines
+    if recorded:
+        lines.append(
+            f"  engine: applied={recorded.get('applied', 0)} "
+            f"skipped={recorded.get('skipped', 0)} "
+            f"bound=[{', '.join(recorded.get('bound', []) or []) or '—'}] "
+            f"actions=[{', '.join(recorded.get('actions', []) or [])}]")
+    if rows:
+        lines.append(f"  {'event':<7} {'action':<18} {'actuator':<16} "
+                     f"{'slo':<14} {'value':>8} {'slo end':>8}  detail")
+        for e in rows:
+            kind = "APPLY" if e.get("event") == "remediation_apply" \
+                else "skip"
+            # the burn-table join: the action's serving SLO and the state
+            # that SLO ended the evaluated window in
+            end = (report.get(e.get("slo"), {}) or {}).get("state", "—")
+            v = e.get("burn", e.get("value"))
+            detail = []
+            if e.get("reason"):
+                detail.append(f"reason={e['reason']}")
+            if e.get("pos") is not None:
+                detail.append(f"pos={e['pos']}")
+            for k in ("rate", "prev_rate", "recommended", "new_shards"):
+                if e.get(k) is not None:
+                    detail.append(f"{k}={e[k]:g}" if isinstance(
+                        e[k], (int, float)) else f"{k}={e[k]}")
+            if e.get("host"):
+                detail.append(f"host={e['host']}")
+            lines.append(
+                f"  {kind:<7} {e.get('action', '?'):<18} "
+                f"{e.get('actuator', '?'):<16} {e.get('slo', '?'):<14} "
+                f"{(f'{v:g}' if isinstance(v, (int, float)) else '—'):>8} "
+                f"{end:>8}  {' '.join(detail)}")
+    return lines
+
+
 def incidents_section(slo_mod, mon_dir):
     lines = ["== incident bundles =="]
     bundles, torn = slo_mod.list_incidents(mon_dir)
@@ -178,7 +238,8 @@ def main(argv=None) -> int:
                          "(list of {name,signal,target,...}); default: "
                          "WF_SLO env, else the built-in default set")
     ap.add_argument("--report", choices=("all", "burn", "timeline",
-                                         "incidents"), default="all",
+                                         "incidents", "remediation"),
+                    default="all",
                     help="which section(s) to render (default all)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output: the evaluation report + "
@@ -229,9 +290,10 @@ def main(argv=None) -> int:
         return 2
     try:
         if args.merge:
-            _latest, series, _journal = dh.merge_monitoring_dirs(args.merge)
+            _latest, series, events = dh.merge_monitoring_dirs(args.merge)
         else:
             _latest, series = dh.load_snapshots(args.monitoring_dir)
+            events = dh.load_journal(args.monitoring_dir)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         where = args.merge or args.monitoring_dir
         print(f"wf_slo: cannot load snapshots from "
@@ -272,6 +334,12 @@ def main(argv=None) -> int:
             "burning": burning,
             "incidents": bundles,
             "incidents_torn": torn,
+            "remediation": {
+                "recorded": next(
+                    (s.get("remediation") for s in reversed(series)
+                     if s.get("remediation")), None),
+                "events": remediation_events(events),
+            },
         }, indent=1, sort_keys=True, default=str))
         return 1 if burning else 0
 
@@ -295,6 +363,8 @@ def main(argv=None) -> int:
         rec = recorded_section(series)
         if args.report == "all" and rec:
             blocks.append(rec)
+    if args.report in ("all", "remediation"):
+        blocks.append(remediation_section(report, series, events))
     if args.report in ("all", "incidents"):
         if args.merge:
             if args.report == "incidents":

@@ -15,6 +15,10 @@ one-screen view every ``--interval`` seconds:
   host-tag the keys, so the view names WHICH host's shard);
 - **SLOs** — per-SLO OK/WARN/PAGE with fast/slow burn and a burn trend
   sparkline over the recent ticks;
+- **remediation** — actuator setpoint gauges (admission tps, governor
+  watermarks, tiered hot_capacity and its recommended value) + the
+  self-driving engine's last-action ledger, when the run had
+  ``remediation=``/``WF_REMEDIATION`` on;
 - **HBM** — per-device headroom, when the health ledger is on;
 - **fleet** — hosts connected / frames / torn-frame counters, when the
   directory is a ``wf_fleet.py serve`` aggregator output.
@@ -266,6 +270,59 @@ def slo_panel(snap, series):
     return lines
 
 
+def remediation_panel(snap):
+    """The self-driving loop at a glance: actuator setpoint gauges (where
+    the knobs currently sit) + the engine's last-action ledger."""
+    rem = snap.get("remediation") or {}
+    ctl = snap.get("control") or {}
+    gauges = ctl.get("gauges") or {}
+    counters = ctl.get("counters") or {}
+    setpoints = [(lbl, gauges.get(g)) for lbl, g in (
+        ("admission tps", "bucket_rate"),
+        ("governor high", "governor_high_watermark"),
+        ("governor low", "governor_low_watermark"),
+        ("hot_capacity", "hot_capacity"),
+        ("rec. hot_cap", "remediation_hot_capacity"),
+        ("rec. delay", "remediation_recommended_delay"),
+    ) if gauges.get(g) is not None]
+    if not rem and not setpoints:
+        return None
+    lines = ["== remediation =="]
+    if setpoints:
+        lines.append("  setpoints: " + "  ".join(
+            f"{lbl}={v:g}" for lbl, v in setpoints))
+    if rem:
+        lines.append(
+            f"  engine: applied={rem.get('applied', 0)} "
+            f"skipped={rem.get('skipped', 0)} "
+            f"bound=[{', '.join(rem.get('bound', []) or []) or '—'}]"
+            + (f"  (counters: actions="
+               f"{counters.get('remediation_actions', 0):g} "
+               f"skips={counters.get('remediation_skips', 0):g})"
+               if counters.get("remediation_actions") is not None
+               or counters.get("remediation_skips") is not None else ""))
+        ledger = rem.get("ledger") or []
+        for e in ledger[-6:]:          # the last-action ledger tail
+            if e.get("applied"):
+                detail = "  ".join(
+                    f"{k}={e[k]:g}" if isinstance(e[k], (int, float))
+                    else f"{k}={e[k]}"
+                    for k in ("rate", "prev_rate", "recommended",
+                              "new_shards", "pos") if e.get(k) is not None)
+                lines.append(f"  APPLY {e.get('action', '?'):<18} "
+                             f"{e.get('actuator', '?'):<16} "
+                             f"slo={e.get('slo', '?')}  {detail}")
+            else:
+                lines.append(f"  skip  {e.get('action', '?'):<18} "
+                             f"{e.get('actuator', '?'):<16} "
+                             f"slo={e.get('slo', '?')}  "
+                             f"reason={e.get('reason', '?')}")
+    if snap.get("remediation_error"):
+        lines.append(f"  REMEDIATION HOOK DEGRADED: "
+                     f"{snap['remediation_error']}")
+    return lines
+
+
 def hbm_panel(snap):
     devices = (snap.get("health") or {}).get("devices") or []
     rows = [d for d in devices if d.get("headroom_bytes") is not None
@@ -289,7 +346,8 @@ def render(dh, mon_dir) -> str:
     blocks = [header(snap, series, mon_dir), stages_panel(snap, series),
               queues_panel(snap)]
     for panel in (event_time_panel(snap), shards_panel(snap),
-                  slo_panel(snap, series), hbm_panel(snap)):
+                  slo_panel(snap, series), remediation_panel(snap),
+                  hbm_panel(snap)):
         if panel:
             blocks.append(panel)
     return "\n\n".join("\n".join(b) for b in blocks)

@@ -1,0 +1,721 @@
+"""Self-driving remediation (PR 17): policy grammar + resolution, the live
+Reporter-tick engine (cooldown/budget/damping/gating/advisory actuators),
+the deterministic commit-barrier engine (windows, damping, state
+round-trip), supervised integration (byte-identical replay with remediation
+active; arbitration against auto-reshard), actuator edge cases (rate change
+mid-held-batch, re-climb during settle blackout), the WF118 validator, the
+wf_slo/wf_top remediation surfaces, and the closed-loop chaos acceptance."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+from windflow_tpu.basic import win_type_t
+from windflow_tpu.batch import Batch
+from windflow_tpu.control import (AdmissionController, CapacityAutotuner,
+                                  ControlConfig, TokenBucket)
+from windflow_tpu.control import _state as control_state
+from windflow_tpu.control import remediation as rem
+from windflow_tpu.observability import (MonitoringConfig, set_journal,
+                                        journal as journal_mod)
+from windflow_tpu.observability.journal import EventJournal
+from windflow_tpu.observability.names import (CONTROL_COUNTERS,
+                                              CONTROL_GAUGES, JOURNAL_EVENTS)
+from windflow_tpu.operators.window import WindowSpec
+from windflow_tpu.runtime.faults import FaultInjector, FaultPlan, FaultSpec
+from windflow_tpu.runtime.supervisor import SupervisedPipeline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    control_state.reset()
+    yield
+    control_state.reset()
+    set_journal(None)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _mkbatch(n, start=0, ts=None):
+    i = np.arange(start, start + n, dtype=np.int32)
+    return Batch(key=jnp.asarray(i % 4), id=jnp.asarray(i),
+                 ts=jnp.asarray(ts if ts is not None else i),
+                 payload={"v": jnp.asarray(i, jnp.float32)},
+                 valid=jnp.ones(n, bool))
+
+
+def _page_snap(slo="lat", burn=3.0, code=2, **extra):
+    snap = {"slo": {slo: {"state": {2: "page", 1: "warn", 0: "ok"}[code],
+                          "code": code, "burn_fast": burn,
+                          "burn_slow": burn}}}
+    snap.update(extra)
+    return snap
+
+
+def _action(**kw):
+    base = dict(name="a", slo="lat", actuator="admission_rate")
+    base.update(kw)
+    return rem.RemediationAction(**base)
+
+
+def _collect(acc):
+    def cb(view):
+        if view is None:
+            return
+        acc.extend(zip(view["key"].tolist(), view["id"].tolist(),
+                       np.asarray(view["payload"]).tolist()))
+    return cb
+
+
+def _src(total, num_keys):
+    return wf.Source(lambda i: {"v": (i % 13).astype(jnp.float32)},
+                     total=total, num_keys=num_keys)
+
+
+def _op(num_keys):
+    return wf.Win_Seq(lambda wid, it: it.sum("v"),
+                      WindowSpec(10, 10, win_type_t.TB), num_keys=num_keys)
+
+
+# ------------------------------------------------------- registry lockstep
+
+
+def test_remediation_names_registered():
+    for ev in ("remediation_apply", "remediation_skip", "tuning_reclimb"):
+        assert ev in JOURNAL_EVENTS
+    for c in ("remediation_actions", "remediation_skips"):
+        assert c in CONTROL_COUNTERS
+    for g in ("bucket_rate", "remediation_hot_capacity",
+              "remediation_recommended_delay"):
+        assert g in CONTROL_GAUGES
+
+
+# --------------------------------------------------------- policy grammar
+
+
+def test_resolve_policy_forms(tmp_path):
+    assert rem.resolve_policy(None) is None
+    assert rem.resolve_policy(False) is None
+    assert rem.resolve_policy("0") is None
+    assert rem.resolve_policy("") is None
+    for on in (True, 1, "1"):
+        p = rem.resolve_policy(on)
+        assert [a.name for a in p.actions] == [a.name for a in
+                                               rem.default_policy().actions]
+    d = {"name": "x", "slo": "lat", "actuator": "admission_rate",
+         "factor": 0.5}
+    assert rem.resolve_policy([d]).actions[0].factor == 0.5
+    assert rem.resolve_policy({"actions": [d]}).actions[0].name == "x"
+    inline = json.dumps([d])
+    assert rem.resolve_policy(inline).actions[0].name == "x"
+    f = tmp_path / "pol.json"
+    f.write_text(inline)
+    assert rem.resolve_policy(str(f)).actions[0].name == "x"
+    existing = rem.default_policy()
+    assert rem.resolve_policy(existing) is existing
+
+
+def test_resolve_policy_rejects_garbage():
+    with pytest.raises(ValueError):
+        rem.resolve_policy("{not json")
+    with pytest.raises(ValueError):
+        rem.resolve_policy([{"name": "x", "slo": "lat",
+                             "actuator": "warp_drive"}])
+    with pytest.raises(ValueError):
+        rem.resolve_policy([{"name": "x", "slo": "lat",
+                             "actuator": "admission_rate",
+                             "flavor": "sour"}])      # unknown field
+    with pytest.raises(ValueError):
+        rem.RemediationPolicy((_action(factor=0.0),))
+    with pytest.raises(ValueError):
+        rem.RemediationPolicy((_action(gate="dispatch_ratio!!0.5"),))
+    with pytest.raises(ValueError):          # duplicate action names
+        rem.RemediationPolicy((_action(name="dup"), _action(name="dup")))
+
+
+def test_policy_problems_checks_spec_names():
+    p = rem.RemediationPolicy((_action(slo="lat"),))
+    assert rem.policy_problems(p, spec_names=["lat"]) == []
+    probs = rem.policy_problems(p, spec_names=["other"])
+    assert probs and "lat" in probs[0]
+
+
+def test_resolve_barrier_policy_ownership():
+    p = rem.resolve_barrier_policy(True, admission=True, shards=1)
+    assert [a.actuator for a in p.actions] == ["admission_rate"]
+    p = rem.resolve_barrier_policy(True, admission=True, shards=4)
+    assert sorted(a.actuator for a in p.actions) == ["admission_rate",
+                                                     "reshard"]
+    p = rem.resolve_barrier_policy(True, admission=False, shards=4)
+    assert [a.actuator for a in p.actions] == ["reshard"]
+    with pytest.raises(ValueError):          # nothing owned
+        rem.resolve_barrier_policy(True, admission=False, shards=1)
+    with pytest.raises(ValueError):          # not barrier-actionable
+        rem.resolve_barrier_policy(
+            [{"name": "x", "slo": "lat", "actuator": "autotune_reclimb"}],
+            admission=True, shards=1)
+    with pytest.raises(ValueError):          # reshard without shards
+        rem.resolve_barrier_policy(
+            [{"name": "x", "slo": "shards", "actuator": "reshard"}],
+            admission=True, shards=1)
+    assert rem.resolve_barrier_policy(None, admission=True, shards=1) is None
+
+
+# ------------------------------------------------------- live engine (unit)
+
+
+def test_live_engine_fires_on_page_only():
+    clk = FakeClock()
+    eng = rem.RemediationEngine(rem.RemediationPolicy((_action(),)),
+                                cooldown_s=1.0, clock=clk)
+    calls = []
+    eng.bind("admission_rate", lambda a: calls.append(a.name) or {})
+    eng.on_verdicts(_page_snap(code=0))
+    eng.on_verdicts(_page_snap(code=1))
+    assert calls == [] and eng.applied == 0
+    snap = _page_snap(code=2)
+    eng.on_verdicts(snap)
+    assert calls == ["a"] and eng.applied == 1
+    assert snap["remediation"]["applied"] == 1    # section folded in place
+    assert snap["remediation"]["ledger"][-1]["action"] == "a"
+    assert snap["remediation"]["bound"] == ["admission_rate"]
+
+
+def test_live_engine_cooldown_budget_and_damping():
+    clk = FakeClock()
+    eng = rem.RemediationEngine(
+        rem.RemediationPolicy((_action(max_applies=4),)),
+        cooldown_s=10.0, max_actions=8, clock=clk)
+    eng.bind("admission_rate", lambda a: {})
+    eng.on_verdicts(_page_snap(burn=4.0))
+    assert eng.applied == 1
+    eng.on_verdicts(_page_snap(burn=4.0))         # inside cooldown
+    assert eng.applied == 1
+    assert eng._per["a"]["last_skip"] == "cooldown"
+    clk.advance(11.0)
+    # burn improved by >10% -> fires again
+    eng.on_verdicts(_page_snap(burn=2.0))
+    assert eng.applied == 2
+    clk.advance(11.0)
+    # burn NOT improved (>= 0.9 * prev) -> damped, permanently stopped
+    eng.on_verdicts(_page_snap(burn=1.9))
+    assert eng.applied == 2
+    assert eng._per["a"]["stopped"]
+    clk.advance(11.0)
+    eng.on_verdicts(_page_snap(burn=0.1))         # even a huge improvement
+    assert eng.applied == 2                        # stays stopped
+
+
+def test_live_engine_run_budget():
+    clk = FakeClock()
+    acts = tuple(_action(name=f"a{k}", max_applies=9) for k in range(3))
+    eng = rem.RemediationEngine(rem.RemediationPolicy(acts),
+                                cooldown_s=0.0, max_actions=2, clock=clk)
+    eng.bind("admission_rate", lambda a: {})
+    eng.on_verdicts(_page_snap())
+    assert eng.applied == 2                        # run budget caps the tick
+    assert eng._per["a2"]["last_skip"] == "run_budget"
+
+
+def test_live_engine_unbound_and_gate():
+    clk = FakeClock()
+    eng = rem.RemediationEngine(
+        rem.RemediationPolicy((
+            _action(name="loose", actuator="autotune_reclimb"),
+            _action(name="gated", gate="dispatch_ratio>=0.5"),)),
+        cooldown_s=0.0, clock=clk)
+    eng.bind("admission_rate", lambda a: {})
+    eng.on_verdicts(_page_snap())                  # no health section at all
+    assert eng._per["loose"]["last_skip"] == "unbound"
+    assert eng._per["gated"]["last_skip"] == "gate_unobserved"
+    eng.on_verdicts(_page_snap(
+        health={"device_time": {"s0": {"dispatch_ratio": 0.2}}}))
+    assert eng._per["gated"]["last_skip"] == "gate"
+    assert eng.applied == 0
+    eng.on_verdicts(_page_snap(
+        health={"device_time": {"s0": {"dispatch_ratio": 0.8}}}))
+    assert eng.applied == 1                        # gate satisfied -> fires
+
+
+def test_live_engine_advisory_hot_capacity_sets_gauge():
+    clk = FakeClock()
+    eng = rem.RemediationEngine(
+        rem.RemediationPolicy((_action(
+            name="grow", actuator="hot_capacity", factor=0.5, floor=1.0),)),
+        cooldown_s=0.0, clock=clk)
+    # nothing observable to scale a recommendation from
+    eng.on_verdicts(_page_snap())
+    assert eng._per["grow"]["last_skip"] == "unobserved"
+    eng.on_verdicts(_page_snap(
+        control={"gauges": {"hot_capacity": 64.0}}))
+    assert eng.applied == 1
+    last = eng._ledger[-1]
+    assert last["recommended"] == 128.0            # ceil(64 / 0.5)
+    assert last["advisory"] is True
+    assert control_state.gauges()["remediation_hot_capacity"] == 128.0
+
+
+def test_live_engine_skip_journals_on_transitions_only(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    set_journal(EventJournal(path))
+    clk = FakeClock()
+    eng = rem.RemediationEngine(
+        rem.RemediationPolicy((_action(actuator="autotune_reclimb"),)),
+        cooldown_s=0.0, clock=clk)
+    for _ in range(5):
+        eng.on_verdicts(_page_snap())              # same reason every tick
+    journal_mod.get_active().close()
+    evs = [e for e in journal_mod.read_journal(path)
+           if e["event"] == "remediation_skip"]
+    assert len(evs) == 1 and evs[0]["reason"] == "unbound"
+    assert eng.skipped == 5                        # counted every time
+    assert control_state.counters()["remediation_skips"] == 5
+
+
+def test_live_engine_actuator_exception_is_contained():
+    clk = FakeClock()
+    eng = rem.RemediationEngine(rem.RemediationPolicy((_action(),)),
+                                cooldown_s=0.0, clock=clk)
+
+    def boom(a):
+        raise RuntimeError("knob fell off")
+
+    eng.bind("admission_rate", boom)
+    eng.on_verdicts(_page_snap())                  # must not raise
+    assert eng.applied == 0
+    assert eng._per["a"]["last_skip"] == "actuator_error:RuntimeError"
+
+
+# -------------------------------------------------- barrier engine (unit)
+
+
+def _barrier_eng(**kw):
+    base = dict(cooldown_barriers=2, max_actions=8)
+    base.update(kw)
+    pol = rem.RemediationPolicy((_action(
+        name="shed", slo="drops", actuator="admission_rate",
+        target=0.1, window=3, max_applies=4),))
+    return rem.BarrierRemediation(pol, **base)
+
+
+def test_barrier_window_and_fire():
+    eng = _barrier_eng()
+    decisions = []
+    for pos in range(5):
+        decisions.extend(eng.on_barrier(pos, {"drop_ratio": 0.5}))
+    fired = [d for d in decisions if d.get("applied")]
+    assert len(fired) == 1 and fired[0]["pos"] == 2    # 3rd violating barrier
+    assert fired[0]["actuator"] == "admission_rate"
+    assert fired[0]["factor"] == 0.7 and fired[0]["floor"] == 1.0
+
+
+def test_barrier_missing_signal_freezes_window():
+    eng = _barrier_eng()
+    eng.on_barrier(0, {"drop_ratio": 0.5})
+    eng.on_barrier(1, {})                          # empty interval: frozen
+    eng.on_barrier(2, {"drop_ratio": 0.5})
+    assert eng.on_barrier(3, {"drop_ratio": 0.5})[0]["applied"]
+    # a clean value below target, by contrast, DOES reset the window
+    eng2 = _barrier_eng()
+    eng2.on_barrier(0, {"drop_ratio": 0.5})
+    eng2.on_barrier(1, {"drop_ratio": 0.0})
+    eng2.on_barrier(2, {"drop_ratio": 0.5})
+    assert not eng2.on_barrier(3, {"drop_ratio": 0.5})
+
+
+def test_barrier_damping_emits_skip_decision():
+    eng = _barrier_eng(cooldown_barriers=1)
+    out = []
+    for pos in range(12):
+        out.extend(eng.on_barrier(pos, {"drop_ratio": 0.5}))
+    applies = [d for d in out if d.get("applied")]
+    damped = [d for d in out if d.get("reason") == "damped"]
+    assert len(applies) == 1                       # no improvement -> damped
+    assert damped and eng.state()["per"]["shed"]["stopped"]
+
+
+def test_barrier_state_roundtrip_determinism():
+    sigs = [{"drop_ratio": v} for v in
+            (0.5, 0.5, 0.0, 0.5, 0.5, 0.5, 0.2, 0.5, 0.5, 0.5)]
+    eng1 = _barrier_eng()
+    out1 = [eng1.on_barrier(p, s) for p, s in enumerate(sigs)]
+    # replay: checkpoint the state at barrier 4, restore into a fresh
+    # engine, and continue — decisions and final state must be identical
+    eng2 = _barrier_eng()
+    for p, s in enumerate(sigs[:4]):
+        eng2.on_barrier(p, s)
+    st = json.loads(json.dumps(eng2.state()))      # survives serialization
+    eng3 = _barrier_eng()
+    eng3.set_state(st)
+    out3 = [eng3.on_barrier(p + 4, s) for p, s in enumerate(sigs[4:])]
+    assert [d for o in out1[4:] for d in o] == [d for o in out3 for d in o]
+    assert eng1.state() == eng3.state()
+
+
+# -------------------------------------------- actuator edge cases (unit)
+
+
+def test_rate_change_mid_held_batch_drop_oldest_ts():
+    """scale_rate while the drop_oldest_ts hold queue is non-empty: held
+    batches are untouched by the rate change and release in ts order at
+    the NEW rate; the shed/admit accounting never double-counts."""
+    clk = FakeClock()
+    adm = AdmissionController(TokenBucket(rate=0.0, burst=10.0, clock=clk),
+                              "drop_oldest_ts", hold_max=4)
+    b0, b1, b2 = (_mkbatch(10, 100 * k) for k in range(3))
+    assert adm.offer(b0) == [b0]                   # burst covers the first
+    assert adm.offer(b1) == [] and adm.offer(b2) == []
+    assert len(adm.held) == 2
+    delta = adm.scale_rate(0.5, floor=40.0)        # mid-hold: floor wins
+    assert delta == {"rate": 40.0, "prev_rate": 0.0}
+    assert len(adm.held) == 2                      # holds untouched
+    assert control_state.gauges()["bucket_rate"] == 40.0
+    clk.advance(0.25)                              # +10 tokens at the new rate
+    out = adm.offer(_mkbatch(10, 300))
+    # FIFO: the oldest HELD batch releases first, the fresh offer queues
+    assert [int(np.asarray(b.id)[0]) for b in out] == [100]
+    assert [int(np.asarray(b.id)[0]) for b, *_ in adm.held] == [200, 300]
+    clk.advance(0.25)
+    out = adm.offer(_mkbatch(10, 400))
+    assert [int(np.asarray(b.id)[0]) for b in out] == [200]
+    drained = adm.drain()                          # EOS admits the tail
+    assert [int(np.asarray(b.id)[0]) for b in drained] == [300, 400]
+    assert adm.admitted == 5 and adm.shed == 0     # nothing double-counted
+    # bucket snapshots stay tokens-only: a remediation-scaled rate must
+    # never leak into checkpoint state (it rides the snapshot's
+    # "remediation" key instead)
+    assert set(adm.state()["bucket"]) == {"tokens"}
+
+
+def test_reclimb_noop_during_settle_blackout(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    set_journal(EventJournal(path))
+    clk = FakeClock()
+    rates = {16: 1000.0, 32: 3000.0, 64: 2000.0}
+    tuner = CapacityAutotuner([16, 32, 64], start_capacity=16,
+                              decide_every=2, settle_batches=3, clock=clk)
+    for _ in range(50):                            # drive to the first switch
+        cap = tuner.capacity
+        clk.advance(cap / rates[cap])
+        tuner.on_batch(cap)
+        if tuner.capacity != 16:
+            break
+    assert tuner.capacity == 32                    # mid-climb, in blackout
+    assert tuner._settle > 0 and not tuner.converged
+    phase_before = tuner._phase
+    tuner.request_reclimb()
+    clk.advance(0.001)
+    tuner.on_batch(tuner.capacity)                 # consumes the event...
+    # ...but the climb in progress IS the re-climb: nothing clobbered
+    assert not tuner.converged
+    assert tuner._phase == phase_before
+    assert tuner.reclimb() is False                # still a no-op
+    journal_mod.get_active().close()
+    evs = journal_mod.read_journal(path)
+    assert not [e for e in evs if e["event"] == "tuning_reclimb"]
+
+
+def test_reclimb_after_convergence_journals_and_reexplores(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    set_journal(EventJournal(path))
+    clk = FakeClock()
+    rates = {16: 1000.0, 32: 4000.0, 64: 2000.0}
+    tuner = CapacityAutotuner([16, 32, 64], start_capacity=16,
+                              decide_every=2, settle_batches=1, clock=clk)
+    for _ in range(300):
+        cap = tuner.capacity
+        clk.advance(cap / rates[cap])
+        tuner.on_batch(cap)
+        if tuner.converged:
+            break
+    assert tuner.converged and tuner.capacity == 32
+    tuner.request_reclimb()
+    clk.advance(0.001)
+    tuner.on_batch(tuner.capacity)
+    assert not tuner.converged                     # re-exploring the ladder
+    journal_mod.get_active().close()
+    evs = journal_mod.read_journal(path)
+    assert [e for e in evs if e["event"] == "tuning_reclimb"]
+
+
+# ------------------------------------------------ supervised integration
+
+
+def _sup_run(total=400, batch=20, faults=None, remediation=True):
+    got = []
+    p = SupervisedPipeline(
+        _src(total, 4), [_op(4)], wf.Sink(_collect(got)),
+        # checkpoint_every=2: with refill = cost/2 the bucket admits every
+        # other batch, so a 2-batch interval sheds at a steady 0.5 ratio —
+        # 5 consecutive violating barriers arm shed_harder's window
+        batch_size=batch, checkpoint_every=2, max_restarts=16,
+        backoff_base=0.001, backoff_cap=0.01, faults=faults,
+        remediation=remediation,
+        control=ControlConfig(autotune=False, backpressure=False,
+                              admission=True,
+                              refill_per_batch=0.5 * batch,
+                              burst_tuples=2 * batch))
+    p.run()
+    return sorted(got), p
+
+
+def test_supervised_remediation_fires_and_replays_byte_identical():
+    base, p_base = _sup_run()
+    st = p_base._remediation.state()
+    assert st["applied"] >= 1                      # shed_harder fired
+    chaos, p_chaos = _sup_run(
+        faults=FaultInjector(FaultPlan(
+            [FaultSpec("chain.step", p=0.15)], seed=7)))
+    assert chaos == base                           # byte-identical replay
+    assert p_chaos._remediation.state() == st      # identical decisions
+
+
+def test_supervised_remediation_journals_applies(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    set_journal(EventJournal(path))
+    _sup_run()
+    journal_mod.get_active().close()
+    set_journal(None)
+    applies = [e for e in journal_mod.read_journal(path)
+               if e["event"] == "remediation_apply"]
+    assert applies and applies[0]["actuator"] == "admission_rate"
+    assert applies[0]["action"] == "shed_harder"
+    assert "pos" in applies[0]                     # barrier coordinate
+    assert "rate" in applies[0] and "prev_rate" in applies[0]
+    assert control_state.counters()["remediation_actions"] >= 1
+
+
+def test_supervised_remediation_off_is_inert():
+    _, p = _sup_run(remediation=None)
+    assert p._remediation is None
+    # the admission snapshot never grows a remediation key when off — the
+    # checkpoint stays byte-for-byte the pre-PR shape
+    assert set(p._admission.state()) == {"bucket", "admitted", "shed"}
+    assert set(p._admission.state()["bucket"]) == {"tokens"}
+
+
+def test_supervised_construction_rejects_unusable_config():
+    with pytest.raises(ValueError):                # nothing owned
+        SupervisedPipeline(_src(100, 4), [_op(4)], wf.Sink(lambda v: None),
+                           batch_size=20, remediation=True)
+    with pytest.raises(ValueError, match="WF118"):  # not barrier-actionable
+        SupervisedPipeline(
+            _src(100, 4), [_op(4)], wf.Sink(lambda v: None), batch_size=20,
+            remediation=[{"name": "x", "slo": "lat",
+                          "actuator": "widen_delay"}],
+            control=ControlConfig(autotune=False, admission=True,
+                                  refill_per_batch=16.0))
+
+
+def test_remediation_vs_auto_reshard_arbitration(tmp_path):
+    """Both engines want the same barrier: the armed auto-reshard governor
+    owns it and remediation defers with a journaled 'arbitration' skip —
+    outputs stay byte-identical to the remediation-free run, and the
+    decision sequence is identical across runs."""
+    # reshard-only policy over a persistently skewed key space: num_keys=3
+    # across 2 shards puts two keys on one shard (hot fraction ~2/3)
+    pol = [{"name": "split", "slo": "shards", "actuator": "reshard",
+            "target": 0.55, "window": 1, "max_applies": 2}]
+
+    def run(name, remediation):
+        path = str(tmp_path / f"{name}.jsonl")
+        set_journal(EventJournal(path))
+        got = []
+        SupervisedPipeline(
+            _src(300, 3), [_op(3)], wf.Sink(_collect(got)),
+            batch_size=20, checkpoint_every=1, max_restarts=4,
+            backoff_base=0.001, backoff_cap=0.01,
+            shards=2, reshard="auto", remediation=remediation).run()
+        journal_mod.get_active().close()
+        set_journal(None)
+        evs = journal_mod.read_journal(path)
+        return sorted(got), [
+            {k: e.get(k) for k in ("event", "action", "reason", "pos")}
+            for e in evs if e["event"].startswith("remediation_")]
+
+    out_rem, evs1 = run("arb1", pol)
+    out_rem2, evs2 = run("arb2", pol)
+    out_off, _ = run("arb3", None)
+    assert out_rem == out_off                # arbitration never diverges
+    assert (out_rem2, evs2) == (out_rem, evs1)    # deterministic decisions
+    skips = [e for e in evs1 if e["event"] == "remediation_skip"]
+    assert skips and all(e["reason"] == "arbitration" for e in skips)
+    assert not [e for e in evs1 if e["event"] == "remediation_apply"]
+
+
+# ------------------------------------------------------------- validator
+
+
+def test_wf118_live_ownership_and_clean():
+    from windflow_tpu.analysis.validate import validate
+    mon = MonitoringConfig(slo=True, remediation=True)
+    p = wf.Pipeline(_src(100, 4), [_op(4)], batch_size=50, monitoring=mon)
+    codes = [d.code for d in validate(p).diagnostics]
+    # the default policy's two actions are both unowned without control=
+    assert codes.count("WF118") == 2
+    p2 = wf.Pipeline(_src(100, 4), [_op(4)], batch_size=50, monitoring=mon,
+                     control=ControlConfig(admission=True, rate_tps=1e9))
+    assert "WF118" not in [d.code for d in validate(p2).diagnostics]
+
+
+def test_wf118_remediation_without_slo():
+    from windflow_tpu.analysis.validate import validate
+    with pytest.raises(ValueError, match="WF118"):
+        MonitoringConfig.resolve(MonitoringConfig(remediation=True))
+    p = wf.Pipeline(_src(100, 4), [_op(4)], batch_size=50)
+    p._monitoring_arg = MonitoringConfig(remediation=True)
+    assert "WF118" in [d.code for d in validate(p).diagnostics]
+
+
+def test_wf118_sub_tick_cooldown():
+    from windflow_tpu.analysis.validate import validate
+    p = wf.Pipeline(_src(100, 4), [_op(4)], batch_size=50)
+    p._monitoring_arg = MonitoringConfig(slo=True, remediation=True,
+                                         remediation_cooldown_s=0.1,
+                                         interval_s=1.0)
+    assert "WF118" in [d.code for d in validate(p).diagnostics]
+
+
+def test_wf118_supervised_surface_clean():
+    from windflow_tpu.analysis.validate import validate
+    p = SupervisedPipeline(
+        _src(100, 4), [_op(4)], wf.Sink(lambda v: None), batch_size=20,
+        remediation=True,
+        control=ControlConfig(autotune=False, admission=True,
+                              refill_per_batch=16.0))
+    assert "WF118" not in [d.code for d in validate(p).diagnostics]
+
+
+def test_wf118_registered_in_lint_rules():
+    from windflow_tpu.analysis.lint import RULES
+    assert "WF118" in RULES
+
+
+# ----------------------------------------------------------- CLI surfaces
+
+
+def _synthetic_rem_dir(tmp_path):
+    """The ci.sh recovered-series shape: 8 burning ticks then 8 healthy
+    ones, the engine section on the final snapshot, one apply + one skip
+    in the journal."""
+    d = tmp_path / "mon"
+    d.mkdir()
+
+    def snap(p99_ms):
+        return {"graph": "t", "operators": [],
+                "e2e_latency_us": {"p99": p99_ms * 1e3,
+                                   "p99_tick": p99_ms * 1e3,
+                                   "samples": 8, "samples_tick": 8}}
+
+    snaps = [snap(50.0) for _ in range(8)] + [snap(0.5) for _ in range(8)]
+    snaps[-1]["remediation"] = {
+        "enabled": True, "applied": 1, "skipped": 2,
+        "bound": ["admission_rate"], "actions": ["shed_harder"],
+        "ledger": [{"action": "shed_harder", "actuator": "admission_rate",
+                    "slo": "lat", "burn": 2.5, "applied": True,
+                    "rate": 100.0, "prev_rate": 200.0}]}
+    snaps[-1]["control"] = {"counters": {"remediation_actions": 1,
+                                         "remediation_skips": 2},
+                            "gauges": {"bucket_rate": 100.0}}
+    with open(d / "snapshots.jsonl", "w") as f:
+        for s in snaps:
+            f.write(json.dumps(s) + "\n")
+    with open(d / "events.jsonl", "w") as f:
+        f.write(json.dumps({"t": 1.0, "wall": 1.0,
+                            "event": "remediation_apply",
+                            "action": "shed_harder",
+                            "actuator": "admission_rate", "slo": "lat",
+                            "burn": 2.5, "applied": True,
+                            "rate": 100.0, "prev_rate": 200.0}) + "\n")
+        f.write(json.dumps({"t": 2.0, "wall": 2.0,
+                            "event": "remediation_skip",
+                            "action": "shed_harder",
+                            "actuator": "admission_rate", "slo": "lat",
+                            "burn": 2.4, "applied": False,
+                            "reason": "damped"}) + "\n")
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps(
+        [{"name": "lat", "signal": "e2e_p99_ms", "target": 10.0,
+          "objective": 0.5, "fast_window": 2, "slow_window": 4}]))
+    return str(d), str(spec)
+
+
+def _poisoned_env(tmp_path):
+    d = tmp_path / "nojax"
+    d.mkdir(exist_ok=True)
+    (d / "jax.py").write_text(
+        "raise ImportError('stdlib CLIs must not import jax')\n")
+    env = {k: v for k, v in os.environ.items() if not k.startswith("WF_")}
+    env["PYTHONPATH"] = str(d)
+    return env
+
+
+def test_wf_slo_remediation_section_and_exit_contract(tmp_path):
+    mon, spec = _synthetic_rem_dir(tmp_path)
+    env = _poisoned_env(tmp_path)
+    cli = os.path.join(REPO, "scripts", "wf_slo.py")
+    r = subprocess.run(
+        [sys.executable, cli, "--monitoring-dir", mon, "--specs", spec,
+         "--report", "remediation"],
+        capture_output=True, text=True, env=env)
+    # the recovered tail ends OK: the remediation section must never
+    # perturb the 0/1/2 exit contract
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "APPLY" in r.stdout and "shed_harder" in r.stdout
+    assert "reason=damped" in r.stdout
+    assert "applied=1" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, cli, "--monitoring-dir", mon, "--specs", spec,
+         "--json"],
+        capture_output=True, text=True, env=env)
+    payload = json.loads(r2.stdout)["remediation"]
+    assert payload["recorded"]["applied"] == 1
+    assert [e["event"] for e in payload["events"]] == [
+        "remediation_apply", "remediation_skip"]
+
+
+def test_wf_top_remediation_panel(tmp_path):
+    mon, _spec = _synthetic_rem_dir(tmp_path)
+    env = _poisoned_env(tmp_path)
+    cli = os.path.join(REPO, "scripts", "wf_top.py")
+    r = subprocess.run(
+        [sys.executable, cli, "--monitoring-dir", mon, "--once"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "== remediation ==" in r.stdout
+    assert "APPLY shed_harder" in r.stdout
+    assert "admission tps=100" in r.stdout         # setpoint gauge line
+
+
+# --------------------------------------------- closed-loop chaos acceptance
+
+
+def test_chaos_sweep_remediate_closed_loop():
+    """The headline acceptance, tier-1 sized: supervised byte-identity with
+    remediation active + the live threaded OK -> PAGE -> actuate ->
+    recover-to-OK loop with the incident bundle recording the actions."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_sweep.py"),
+         "--seeds", "1", "--total", "200", "--batch", "20", "--remediate"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[closed-loop] threaded:" in r.stdout
+    assert "remediation action(s), OK" in r.stdout

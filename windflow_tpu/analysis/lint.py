@@ -26,6 +26,13 @@ WF117  error     telemetry config the run cannot honor (a
                  Reporter tick — no frames could ever stream), an
                  endpoint that does not parse (``tcp://HOST:PORT`` /
                  ``unix:///path.sock``), or an outbox capacity < 1
+WF118  error     remediation config the run cannot honor (a
+                 validate()-time code, registered in RULES for
+                 --explain/--select): ``WF_REMEDIATION`` set while
+                 monitoring/SLO resolve off, an unresolvable policy,
+                 an action naming an actuator the run config does not
+                 own, a sub-tick cooldown, or a non-barrier actuator
+                 under the supervised drivers
 WF200  error     scanned file fails to parse (the linter cannot see it)
 WF201  error     ``WF_*`` env read missing from ``docs/ENV_FLAGS.md``
 WF202  error     ENV_FLAGS.md row does not state WHEN the flag is read
@@ -105,6 +112,13 @@ RULES: Dict[str, Tuple[str, str]] = {
     "WF117": ("error", "telemetry config the run cannot honor "
                        "(WF_TELEMETRY while monitoring off, "
                        "missing/unparseable endpoint, outbox < 1)"),
+    # WF118 is likewise validate()-time (validate.py::_check_remediation /
+    # _check_remediation_supervised)
+    "WF118": ("error", "remediation config the run cannot honor "
+                       "(WF_REMEDIATION while monitoring/SLO off, "
+                       "unresolvable policy, unowned actuator, "
+                       "sub-tick cooldown, non-barrier actuator under "
+                       "supervision)"),
     "WF200": ("error", "scanned file fails to parse (the linter cannot "
                        "see it)"),
     "WF201": ("error", "WF_* env read missing from docs/ENV_FLAGS.md"),

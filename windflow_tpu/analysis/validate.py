@@ -85,6 +85,20 @@ WF117  error     telemetry config the run cannot honor
                  ever stream), a telemetry endpoint that does not
                  parse (``tcp://HOST:PORT`` / ``unix:///path.sock``),
                  or an outbox capacity < 1 (cannot hold one frame)
+WF118  error     remediation config the run cannot honor
+                 (``control/remediation.py``): ``WF_REMEDIATION`` set
+                 while monitoring itself resolves off (live mode rides
+                 the SLO engine's Reporter-tick verdicts — no action
+                 could ever fire), remediation on while the SLO engine
+                 is off, a policy that does not resolve (unknown
+                 actuator / unknown SLO name / unparseable gate), a
+                 cooldown below the reporter tick, an action naming an
+                 actuator the run config does not own (admission rate
+                 without an admission bucket, autotune re-climb with
+                 the tuner off, reshard under a live driver), or — on
+                 the supervised drivers — an action whose actuator has
+                 no deterministic barrier signal (replay could not
+                 re-derive it)
 WF114  warn/err  tiered keyed state (``windflow_tpu/state``) combined
                  with a configuration its determinism/sizing contract
                  cannot honor: sequence-id tracing or wall-clock
@@ -766,6 +780,151 @@ def _check_telemetry(report, stored_monitoring) -> None:
                  "integer (default 64 ticks of backlog)")
 
 
+def _check_remediation(report, stored_monitoring, control_cfg) -> None:
+    """WF118: the remediation mirror of WF116 — resolve the monitoring
+    config exactly as the Monitor will and reject remediation policies the
+    run cannot honor before it starts (the MonitoringConfig/Monitor raise
+    the same problems loudly at construction; this surfaces them pre-run
+    with the operator-path/hint shape).  Live-driver surface: ownership is
+    checked against the CONTROL config — an action naming an actuator whose
+    subsystem is off could only ever skip, never act."""
+    import os
+    from ..control import remediation as _remediation
+    from ..observability import MonitoringConfig
+    from ..observability import slo as _slo
+    try:
+        cfg = MonitoringConfig.resolve(stored_monitoring)
+    except (ValueError, TypeError) as e:
+        if "remediation" in str(e).lower():
+            report.add(
+                "WF118", "error", "monitoring.remediation",
+                f"monitoring/remediation config does not resolve: "
+                f"{type(e).__name__}: {e}",
+                hint="remediation requires the SLO engine (slo=/WF_SLO), a "
+                     "cooldown >= the reporter interval, and "
+                     "max_actions >= 1")
+        return                          # otherwise WF113's diagnosis
+    if cfg is None:
+        env = os.environ.get("WF_REMEDIATION", "")
+        if env not in ("", "0"):
+            report.add(
+                "WF118", "error", "monitoring.remediation",
+                "WF_REMEDIATION is set but monitoring itself resolves off — "
+                "the remediation engine rides the SLO engine's Reporter-tick "
+                "verdicts, so no action could ever fire",
+                hint="enable monitoring alongside the sub-toggle: "
+                     "WF_MONITORING=1 (or monitoring=/MonitoringConfig("
+                     "remediation=...) on the driver); note the supervised "
+                     "drivers consume WF_REMEDIATION directly (barrier "
+                     "mode) and need no monitoring")
+        return
+    try:
+        policy = _remediation.resolve_policy(cfg.remediation)
+    except (ValueError, TypeError) as e:
+        report.add(
+            "WF118", "error", "monitoring.remediation",
+            f"remediation policy does not resolve: {type(e).__name__}: {e}",
+            hint="remediation=/WF_REMEDIATION accept True/'1' (the default "
+                 "policy), a RemediationPolicy, a list of actions/dicts, a "
+                 "JSON file path, or inline JSON (actions = {name, slo, "
+                 "actuator, ...})")
+        return
+    if policy is None:
+        return
+    try:
+        spec_names = [s.name for s in (_slo.resolve_specs(cfg.slo) or [])]
+    except (ValueError, TypeError, OSError):
+        spec_names = None               # already diagnosed as WF116
+    for prob in _remediation.policy_problems(policy, spec_names or None):
+        report.add(
+            "WF118", "error", "monitoring.remediation", prob,
+            hint=f"actuators: {', '.join(sorted(_remediation.ACTUATORS))}; "
+                 f"every action's slo must name a configured SLOSpec")
+    # ownership: an actuator whose owning subsystem the control config has
+    # off can only ever skip (reason 'unbound') — reject it pre-run
+    for a in policy.actions:
+        where = f"remediation[{a.name}]"
+        if a.actuator == "admission_rate" and (
+                control_cfg is None or not control_cfg.admission):
+            report.add(
+                "WF118", "error", where,
+                "actuator 'admission_rate' but the run has no admission "
+                "controller — the action could only ever skip as 'unbound'",
+                hint="enable ControlConfig(admission=True, ...) (control=/"
+                     "WF_CONTROL) alongside the policy, or drop the action")
+        elif a.actuator == "autotune_reclimb" and (
+                control_cfg is None or not control_cfg.autotune):
+            report.add(
+                "WF118", "error", where,
+                "actuator 'autotune_reclimb' but the autotuner is off — "
+                "the action could only ever skip as 'unbound'",
+                hint="enable ControlConfig(autotune=True) (the Pipeline "
+                     "driver's capacity ladder), or drop the action")
+        elif a.actuator == "reshard":
+            report.add(
+                "WF118", "error", where,
+                "actuator 'reshard' under a live driver — re-sharding is "
+                "the sharded supervisor's barrier actuator, never bound by "
+                "the live drivers",
+                hint="run SupervisedPipeline(shards=N, remediation=...) for "
+                     "remediation-driven resharding, or drop the action")
+
+
+def _check_remediation_supervised(report, sp) -> None:
+    """WF118 (barrier surface): re-resolve the supervised driver's
+    ``remediation=``/``WF_REMEDIATION`` argument exactly as its constructor
+    does — every action must be barrier-actionable AND owned by the run
+    config (deterministic admission bucket / shards > 1)."""
+    import os
+    from ..control import remediation as _remediation
+    arg = getattr(sp, "_remediation_arg", None)
+    if arg is None:
+        arg = os.environ.get("WF_REMEDIATION")
+    try:
+        policy = _remediation.resolve_barrier_policy(
+            arg, admission=getattr(sp, "_admission", None) is not None,
+            shards=getattr(sp, "_shards", 1))
+    except (ValueError, TypeError) as e:
+        report.add(
+            "WF118", "error", "supervised.remediation",
+            f"supervised remediation config cannot work: "
+            f"{type(e).__name__}: {e}",
+            hint="barrier mode fires only actuators with deterministic "
+                 "committed signals: 'admission_rate' (needs ControlConfig("
+                 "admission=True, refill_per_batch=...)) and 'reshard' "
+                 "(needs shards > 1); use the live drivers' monitoring= "
+                 "remediation for the rest")
+        return
+    if policy is None:
+        return
+    cool = os.environ.get("WF_REMEDIATION_COOLDOWN_S", "")
+    if cool:
+        try:
+            ok = float(cool) >= 0
+        except ValueError:
+            ok = False
+        if not ok:
+            report.add(
+                "WF118", "error", "supervised.remediation",
+                f"WF_REMEDIATION_COOLDOWN_S={cool!r} does not parse as a "
+                f"non-negative number",
+                hint="barrier mode rounds the cooldown to whole barriers "
+                     "(>= 1)")
+    maxa = os.environ.get("WF_REMEDIATION_MAX_ACTIONS", "")
+    if maxa:
+        try:
+            ok = int(maxa) >= 1
+        except ValueError:
+            ok = False
+        if not ok:
+            report.add(
+                "WF118", "error", "supervised.remediation",
+                f"WF_REMEDIATION_MAX_ACTIONS={maxa!r} must be an integer "
+                f">= 1",
+                hint="the per-run action budget bounds remediation blast "
+                     "radius, like slo_max_incidents bounds bundles")
+
+
 def _check_kernel_records(report) -> None:
     """WF109: compare every kernel-impl choice the registry recorded at
     trace time against what it would resolve to NOW (env/tuning-cache as of
@@ -1132,6 +1291,7 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _check_health(report, getattr(p, "_monitoring_arg", None))
     _check_slo(report, getattr(p, "_monitoring_arg", None))
     _check_telemetry(report, getattr(p, "_monitoring_arg", None))
+    _check_remediation(report, getattr(p, "_monitoring_arg", None), cfg)
     _check_dispatch(report, dispatch, getattr(p, "_dispatch_arg", None), cfg,
                     trace, getattr(p, "_trace_arg", None), supervised)
 
@@ -1157,6 +1317,7 @@ def _validate_supervised(report, sp, faults, control, trace=None,
     _check_health(report, getattr(sp, "_monitoring_arg", None))
     _check_slo(report, getattr(sp, "_monitoring_arg", None))
     _check_telemetry(report, getattr(sp, "_monitoring_arg", None))
+    _check_remediation_supervised(report, sp)
     _check_dispatch(report, dispatch, getattr(sp, "_dispatch_arg", None),
                     cfg, trace, getattr(sp, "_trace_arg", None), True)
     _check_shards(report,
@@ -1212,6 +1373,7 @@ def _validate_threaded(report, tp, faults, control, supervised,
     _check_health(report, getattr(tp, "_monitoring_arg", None))
     _check_slo(report, getattr(tp, "_monitoring_arg", None))
     _check_telemetry(report, getattr(tp, "_monitoring_arg", None))
+    _check_remediation(report, getattr(tp, "_monitoring_arg", None), cfg)
     _check_dispatch(report, dispatch, getattr(tp, "_dispatch_arg", None),
                     cfg, trace, getattr(tp, "_trace_arg", None), supervised,
                     edges=edges)
@@ -1325,6 +1487,7 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_health(report, getattr(g, "_monitoring_arg", None))
     _check_slo(report, getattr(g, "_monitoring_arg", None))
     _check_telemetry(report, getattr(g, "_monitoring_arg", None))
+    _check_remediation(report, getattr(g, "_monitoring_arg", None), cfg)
     dedges = None
     if threaded:
         try:

@@ -23,6 +23,12 @@ nothing consumed them. This package closes the loop:
   limiting (:class:`TokenBucket` wall-clock / :class:`PositionBucket`
   deterministic-for-replay) + pluggable shed policy (``drop_newest`` /
   ``drop_oldest_ts``) at every driver's ingest boundary.
+- ``remediation.py`` — :class:`RemediationPolicy`/:class:`RemediationEngine`:
+  self-driving remediation mapping SLO burn signatures to these actuators —
+  live on the Reporter tick, or as the deterministic
+  :class:`BarrierRemediation` at supervised commit barriers (checkpointed
+  decision state, byte-identical replay). Behind ``remediation=`` /
+  ``WF_REMEDIATION``.
 
 Everything is **off by default** and enabled per driver via ``control=``
 (True, a dict of :class:`ControlConfig` fields, a config object) or
@@ -41,6 +47,11 @@ from .autotune import (CapacityAutotuner, Rebatcher, TuningCache,
                        dispatch_tuning_key, payload_signature, tuning_key)
 from .config import ControlConfig
 from .governor import BackpressureGovernor, governor_from_config
+from .remediation import (ACTUATORS, BarrierRemediation, RemediationAction,
+                          RemediationEngine, RemediationPolicy,
+                          barrier_policy_problems, default_barrier_policy,
+                          default_policy, resolve_barrier_policy,
+                          resolve_policy)
 
 __all__ = [
     "ControlConfig", "AdmissionController", "TokenBucket", "PositionBucket",
@@ -48,5 +59,9 @@ __all__ = [
     "build_ladder", "chain_signature", "payload_signature", "device_kind",
     "tuning_key", "dispatch_tuning_key", "admission_from_config",
     "admission_group", "bucket_from_config", "governor_from_config",
+    "RemediationAction", "RemediationPolicy", "RemediationEngine",
+    "BarrierRemediation", "ACTUATORS", "default_policy", "resolve_policy",
+    "default_barrier_policy", "resolve_barrier_policy",
+    "barrier_policy_problems",
     "counters", "gauges", "reset", "bump", "set_gauge",
 ]

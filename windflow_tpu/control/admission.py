@@ -72,6 +72,12 @@ class TokenBucket:
             return True
         return False
 
+    def get_rate(self) -> float:
+        return self.rate
+
+    def set_rate(self, value: float) -> None:
+        self.rate = float(value)
+
     def state(self) -> dict:
         return {"tokens": self.tokens}
 
@@ -99,7 +105,17 @@ class PositionBucket:
             return True
         return False
 
+    def get_rate(self) -> float:
+        return self.refill
+
+    def set_rate(self, value: float) -> None:
+        self.refill = float(value)
+
     def state(self) -> dict:
+        # NOTE deliberately tokens-only: the refill rate is config, not
+        # accumulated state — remediation-scaled rates ride the snapshot's
+        # "remediation" key instead (runtime/supervisor.py), so checkpoints
+        # taken with remediation OFF stay byte-for-byte unchanged
         return {"tokens": self.tokens}
 
     def set_state(self, st: dict) -> None:
@@ -196,6 +212,34 @@ class AdmissionController:
                 out.append(b)
             return out
 
+    # -- remediation actuator surface ---------------------------------------
+
+    def current_rate(self) -> float:
+        with self._lock:
+            return self.bucket.get_rate()
+
+    def set_rate(self, value: float) -> None:
+        """Restore/replay path: pin the bucket's refill rate outright (the
+        remediation-scaled rate rides the supervisor snapshot's
+        ``"remediation"`` key, not the bucket state)."""
+        with self._lock:
+            self.bucket.set_rate(value)
+            _state.set_gauge("bucket_rate", float(value))
+
+    def scale_rate(self, factor: float, floor: float = 1.0) -> dict:
+        """The ``admission_rate`` remediation actuator: multiply the bucket's
+        refill rate by ``factor`` (tighten: factor < 1), clamped at ``floor``.
+        Takes the bucket lock, so a rate change is atomic w.r.t. a racing
+        ``offer`` — held batches (drop_oldest_ts) are untouched; the next
+        ``tick()`` simply refills at the new rate.  Returns the setpoint
+        delta for the journal/ledger."""
+        with self._lock:
+            cur = float(self.bucket.get_rate())
+            new = max(float(floor), cur * float(factor))
+            self.bucket.set_rate(new)
+            _state.set_gauge("bucket_rate", new)
+            return {"rate": round(new, 3), "prev_rate": round(cur, 3)}
+
     # -- supervised snapshot/restore ---------------------------------------
 
     def state(self) -> dict:
@@ -232,8 +276,10 @@ def bucket_from_config(cfg, base_capacity: int,
         return None
     burst = resolve_burst(cfg, base_capacity)
     if cfg.refill_per_batch is not None:
+        _state.set_gauge("bucket_rate", float(cfg.refill_per_batch))
         return PositionBucket(cfg.refill_per_batch, burst)
     if cfg.rate_tps is not None:
+        _state.set_gauge("bucket_rate", float(cfg.rate_tps))
         return TokenBucket(cfg.rate_tps, burst, clock=clock)
     return None                               # admission on, rate unlimited
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 from functools import reduce
 from typing import List, Optional
@@ -284,6 +285,11 @@ class CapacityAutotuner:
         self._win_batches = 0
         self._win_tuples = 0
         self._win_t0: Optional[float] = None
+        # remediation re-climb request (control/remediation.py): SET from
+        # the Reporter thread, CONSUMED by the driver loop at the next
+        # on_batch boundary — the Event is the only cross-thread surface;
+        # all tuner state stays single-writer[driver]
+        self._reclimb = threading.Event()
 
     # -- decision core (pure w.r.t. time: rates come in from outside) -------
 
@@ -339,11 +345,42 @@ class CapacityAutotuner:
                 "ladder": self.ladder, "name": self.name})
         return self._switch(best)
 
+    # -- remediation actuator surface ---------------------------------------
+
+    def request_reclimb(self) -> None:
+        """The ``autotune_reclimb`` remediation actuator: ask the driver loop
+        to un-converge this tuner at its next batch boundary.  Thread-safe
+        (an Event set); actuation itself happens on the driver thread via
+        :meth:`reclimb`."""
+        self._reclimb.set()
+
+    def reclimb(self) -> bool:
+        """Driver-thread: un-converge and re-explore the ladder from the
+        current rung.  A tuner still exploring (including one inside a
+        settle blackout after a switch) is a no-op — the climb in progress
+        IS the re-climb; clobbering its window/blackout mid-measurement
+        would poison the rate it is collecting."""
+        if not self.converged:
+            return False
+        self.converged = False
+        self._rates = {}
+        self._phase = "up"
+        self._prev_rate = None
+        self._seed = self.capacity
+        self._settle = self.settle_batches
+        self._win_t0 = None
+        _journal.record("tuning_reclimb", tuner=self.name,
+                        capacity=self.capacity)
+        return True
+
     # -- driver-loop surface ------------------------------------------------
 
     def on_batch(self, n_tuples: int) -> Optional[int]:
         """Account one pushed batch; returns a new capacity on a decision
         boundary that switched rungs, else None."""
+        if self._reclimb.is_set():
+            self._reclimb.clear()
+            self.reclimb()
         if self.converged:
             return None
         if self._settle > 0:

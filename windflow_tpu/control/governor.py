@@ -37,6 +37,11 @@ class BackpressureGovernor:
         self.low_watermark = float(low_watermark)
         self.poll_s = float(poll_s)
         self.clock = clock
+        # actuator setpoints (PR 17): the watermark fractions this governor
+        # throttles on, observable beside the remediation ledger so a
+        # before/after delta is visible in the snapshot + Prometheus
+        _state.set_gauge("governor_high_watermark", self.high_watermark)
+        _state.set_gauge("governor_low_watermark", self.low_watermark)
         #: set while the governor is actively throttling — the prefetch
         #: pause hook (pass it as ``pause_event=`` to ``batches_prefetched``)
         self.pause_event = threading.Event()

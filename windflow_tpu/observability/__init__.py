@@ -35,6 +35,11 @@ enabled per graph/pipeline via ``PipeGraph(..., monitoring=...)`` /
                                  # from WF_TELEMETRY_ENDPOINT, else the value
                                  # IS the endpoint; see
                                  # MonitoringConfig.telemetry + fleet.py)
+    WF_REMEDIATION=1             # self-driving remediation sub-toggle ('1' =
+                                 # default policy, else JSON path/inline;
+                                 # requires the SLO engine; see
+                                 # MonitoringConfig.remediation +
+                                 # control/remediation.py)
 """
 
 from __future__ import annotations
@@ -163,6 +168,28 @@ class MonitoringConfig:
     #: bounded outbox depth between the Reporter tick and the telemetry
     #: sender thread (``WF_TELEMETRY_OUTBOX``; must be >= 1 — WF117)
     telemetry_outbox: int = 64
+    #: self-driving remediation sub-toggle (off by default): a declarative
+    #: :class:`~windflow_tpu.control.remediation.RemediationPolicy` mapping
+    #: SLO burn signatures to the actuators the run owns (admission rate,
+    #: autotuner re-climb, ...), evaluated on the Reporter tick right after
+    #: the SLO verdicts (``SLOEngine.verdict_hook``) — so the incident
+    #: bundle a PAGE commits records the actions the page triggered.
+    #: Accepts ``True`` (default policy), a policy/action list, or a JSON
+    #: file path / inline JSON.  REQUIRES the SLO engine: remediation on
+    #: while ``slo`` resolves off is a construction-time ValueError (WF118
+    #: pre-run).  Host-side Reporter-thread work ONLY — compiled programs,
+    #: operator state, and the perf-gate pins are byte-for-byte unchanged
+    #: either way.  Env override: ``WF_REMEDIATION`` (``''``/``'0'`` off,
+    #: ``'1'`` default policy, anything else a policy path / inline JSON);
+    #: analyze with ``scripts/wf_slo.py --report remediation``.
+    remediation: object = False
+    #: minimum seconds between remediation actions + hard cap per run (the
+    #: incident-bundle rate-limit pattern) — ``WF_REMEDIATION_COOLDOWN_S``
+    #: / ``WF_REMEDIATION_MAX_ACTIONS``.  The cooldown must be >= the
+    #: reporter interval (a sub-tick cooldown cannot rate-limit anything
+    #: — WF118, loud at construction)
+    remediation_cooldown_s: float = 60.0
+    remediation_max_actions: int = 8
 
     def should_sample_e2e(self, n: int) -> bool:
         """THE e2e sampling policy, shared by every driver: every Nth source
@@ -232,6 +259,35 @@ class MonitoringConfig:
         tb = os.environ.get("WF_TELEMETRY_OUTBOX", "")
         if tb:
             cfg = dataclasses.replace(cfg, telemetry_outbox=int(tb))
+        rv = os.environ.get("WF_REMEDIATION")
+        if rv is not None and rv != "":
+            cfg = dataclasses.replace(
+                cfg, remediation=(False if rv == "0"
+                                  else (True if rv == "1" else rv)))
+        rc = os.environ.get("WF_REMEDIATION_COOLDOWN_S", "")
+        if rc:
+            cfg = dataclasses.replace(cfg, remediation_cooldown_s=float(rc))
+        rm = os.environ.get("WF_REMEDIATION_MAX_ACTIONS", "")
+        if rm:
+            cfg = dataclasses.replace(cfg, remediation_max_actions=int(rm))
+        if cfg.remediation not in (False, None, "", "0"):
+            if cfg.slo in (False, None, "", "0"):
+                raise ValueError(
+                    "remediation=/WF_REMEDIATION is on but the SLO engine "
+                    "(slo=/WF_SLO) resolves off — remediation consumes SLO "
+                    "verdicts, so there is nothing to act on (the validator "
+                    "reports this as WF118 before the run)")
+            if float(cfg.remediation_cooldown_s) < float(cfg.interval_s):
+                raise ValueError(
+                    f"remediation_cooldown_s/WF_REMEDIATION_COOLDOWN_S "
+                    f"({cfg.remediation_cooldown_s}) must be >= the reporter "
+                    f"interval ({cfg.interval_s}s) — a sub-tick cooldown "
+                    f"cannot rate-limit anything (WF118 before the run)")
+            if int(cfg.remediation_max_actions) < 1:
+                raise ValueError(
+                    f"remediation_max_actions/WF_REMEDIATION_MAX_ACTIONS "
+                    f"must be >= 1, got {cfg.remediation_max_actions} "
+                    f"(WF118 before the run)")
         if cfg.snapshot_keep is not None and int(cfg.snapshot_keep) < 1:
             raise ValueError(
                 f"snapshot_keep/WF_SNAPSHOT_KEEP must be >= 1 (or unset "
@@ -310,6 +366,32 @@ class Monitor:
                 max_incidents=config.slo_max_incidents,
                 journal_path=journal_path,
                 fingerprint=self._config_fingerprint)
+        #: remediation engine (MonitoringConfig.remediation): resolved here
+        #: so an unusable policy fails the run loudly at Monitor
+        #: construction (the SLO-engine convention; validate() reports it
+        #: as WF118 pre-run).  Subscribed to the SLO engine's per-tick
+        #: verdicts; the drivers bind the actuators the run actually owns
+        #: in run() (an unbound actuator skips loudly, never guesses)
+        self.remediation = None
+        from ..control import remediation as _remediation
+        policy = _remediation.resolve_policy(config.remediation)
+        if policy is not None:
+            if self.slo is None:
+                raise ValueError(
+                    "remediation=/WF_REMEDIATION is on but the SLO engine "
+                    "(slo=/WF_SLO) is off — remediation consumes SLO "
+                    "verdicts (WF118 before the run)")
+            probs = _remediation.policy_problems(
+                policy, [s.name for s in specs])
+            if probs:
+                raise ValueError(
+                    "invalid remediation policy (the validator reports "
+                    "these as WF118 before the run): " + "; ".join(probs))
+            self.remediation = _remediation.RemediationEngine(
+                policy, cooldown_s=config.remediation_cooldown_s,
+                max_actions=config.remediation_max_actions)
+            self.slo.verdict_hook = self.remediation.on_verdicts
+            self.slo.remediation = self.remediation
         #: fleet telemetry agent (MonitoringConfig.telemetry): constructed
         #: here so a missing/unparseable endpoint or an outbox < 1 fails
         #: the run loudly at Monitor construction (the SLO-engine

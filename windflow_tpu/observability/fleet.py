@@ -439,7 +439,10 @@ class FleetSLOEngine(_slo.SLOEngine):
         self._host_forensics = host_forensics
 
     def _extra_bundle_files(self, st, snap: dict) -> dict:
-        row = (snap.get("slo") or {}).get(st.spec.name) or {}
+        # the merged HOST fold (worst_host/pages_by_host), not the fleet
+        # engine's own rows — by capture time snap["slo"] holds the latter
+        row = (self._incoming_slo or snap.get("slo")
+               or {}).get(st.spec.name) or {}
         pages_by_host = row.get("pages_by_host") or {}
         hosts = []
         for h in self._host_forensics():

@@ -89,6 +89,15 @@ JOURNAL_EVENTS = (
     # tag's first frame / a host stream close (host, mon_dir on join)
     "telemetry_connect", "telemetry_lost",
     "fleet_host_join", "fleet_host_leave",
+    # self-driving remediation (control/remediation.py, evaluated on the
+    # Reporter tick in live mode / at commit barriers in supervised mode):
+    # "remediation_apply" = a policy action fired an actuator (action/
+    # actuator/slo + burn or barrier pos + setpoint details);
+    # "remediation_skip" = an action wanted to fire but was held back —
+    # reason says why (cooldown, run/action budget, damped, unbound
+    # actuator, gate, arbitration loss to auto-reshard); "tuning_reclimb" =
+    # a converged autotuner was un-converged to re-explore its ladder
+    "remediation_apply", "remediation_skip", "tuning_reclimb",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -131,6 +140,10 @@ CONTROL_COUNTERS = (
     # rank.py): sessions closed by the data-dependent triggerer, and
     # leaderboard candidates evicted by the top-N rank merge
     "sessions_closed", "topn_evictions",
+    # self-driving remediation (control/remediation.py): policy actions
+    # that fired an actuator, and actions held back (cooldown / budget /
+    # damping / unbound / gate / arbitration)
+    "remediation_actions", "remediation_skips",
 )
 
 #: control-plane gauges (``control/_state.py::set_gauge``; Prometheus
@@ -145,6 +158,20 @@ CONTROL_GAUGES = (
     # upsert count of the most recently synced table (last-write-wins
     # across tables, the chosen_capacity convention)
     "join_table_version",
+    # actuator setpoints (PR 17 remediation observability): current
+    # admission bucket refill rate (control/admission.py, updated by
+    # scale_rate), governor high/low queue-depth watermarks
+    # (control/governor.py), and the tiered hot-capacity target the run
+    # was built with (operators/join.py / operators/rank.py tier wiring,
+    # last-write-wins across tables) — so remediation deltas are
+    # observable before/after each action
+    "bucket_rate", "governor_high_watermark", "governor_low_watermark",
+    "hot_capacity",
+    # advisory remediation recommendations (control/remediation.py):
+    # geometry-baked setpoints (tiered hot capacity, watermark delay) are
+    # traced constants, so their actuators gauge a recommendation for the
+    # next restart instead of mutating a live trace
+    "remediation_hot_capacity", "remediation_recommended_delay",
 )
 
 #: per-STAGE counters exported in the metrics snapshot's operator rows

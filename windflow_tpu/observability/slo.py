@@ -439,15 +439,35 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
         #: also be a monitored host, and its fleet pages must land in the
         #: fleet events.jsonl, never the co-resident host's
         self.journal_sink = None
+        #: per-tick verdict subscriber (``fn(snap)``), called AFTER the
+        #: ``"slo"`` section is folded and BEFORE incident capture — the
+        #: remediation engine (control/remediation.py) rides here, so the
+        #: actions it takes on the triggering tick land inside the
+        #: triggering bundle.  Same thread as observe (Reporter); a hook
+        #: failure is recorded on the snapshot, never kills the tick
+        self.verdict_hook = None
+        #: the bound RemediationEngine (or None): duck-typed — incident
+        #: capture asks it for ``section()`` to commit ``remediation.json``
+        #: into every bundle before the manifest
+        self.remediation = None
+        self._incoming_slo = None
 
     # -- evaluation --------------------------------------------------------
 
     def observe(self, snap: dict) -> dict:
         """One tick: extract every signal, advance the burn windows, run the
-        state machines, journal transitions, capture incidents on PAGE
-        entry, and fold the ``"slo"`` section into ``snap`` (returned)."""
+        state machines, journal transitions, run the verdict hook, capture
+        incidents on PAGE entry, and fold the ``"slo"`` section into
+        ``snap`` (returned)."""
         self._tick += 1
         sec: Dict[str, dict] = {}
+        paged = []
+        #: the slo section as the snapshot ARRIVED (the merged host fold on
+        #: a fleet aggregator — carries worst_host/pages_by_host).  Capture
+        #: used to run before the ``snap["slo"] = sec`` fold and read it
+        #: from snap directly; now that the verdict hook runs in between,
+        #: subclasses (FleetSLOEngine.correlation) read it from here
+        self._incoming_slo = snap.get("slo")
         for st in self._states:
             spec = st.spec
             extractor, _mode = SIGNALS[spec.signal]
@@ -457,15 +477,30 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
                 st.window.append(spec.violated(value))
                 st.burn_fast = st._burn(int(spec.fast_window))
                 st.burn_slow = st._burn(int(spec.slow_window))
-                self._step_state(st, snap)
+                if self._step_state(st, snap):
+                    paged.append(st)
             st.history.append((self._tick, st.last_value, st.burn_fast,
                                st.burn_slow, st.state))
             sec[spec.name] = st.row()
         snap["slo"] = sec
+        # verdict hook BEFORE capture: remediation acts on this tick's
+        # verdicts first, so the bundle a PAGE is about to commit records
+        # the actions the page itself triggered
+        if self.verdict_hook is not None:
+            try:
+                self.verdict_hook(snap)
+            except Exception as e:  # noqa: BLE001 — a broken hook must not
+                # kill the tick, and must not die silently: the snapshot
+                # carries the error (the slo_error convention)
+                snap["remediation_error"] = f"{type(e).__name__}: {e}"
+        for st in paged:
+            self._maybe_capture(st, snap)
         self._prev = snap
         return snap
 
-    def _step_state(self, st: _SLOState, snap: dict) -> None:
+    def _step_state(self, st: _SLOState, snap: dict) -> bool:
+        """Advance one SLO's state machine; returns True on PAGE entry (the
+        caller captures the incident AFTER the verdict hook has run)."""
         spec = st.spec
         before = st.state
         if st.state == STATE_PAGE:
@@ -482,7 +517,7 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
             else:
                 st.state = STATE_OK
         if st.state == before:
-            return
+            return False
         st.transitions.append((self._tick, before, st.state))
         if st.state == STATE_PAGE:
             st.pages += 1
@@ -491,11 +526,12 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
                              signal=spec.signal, value=st.last_value,
                              target=spec.target, burn_fast=st.burn_fast,
                              burn_slow=st.burn_slow, tick=self._tick)
-            self._maybe_capture(st, snap)
-        elif st.state == STATE_OK and self.journal:
+            return True
+        if st.state == STATE_OK and self.journal:
             self._record("slo_recover", slo=spec.name,
                          from_state=before, burn_fast=st.burn_fast,
                          burn_slow=st.burn_slow, tick=self._tick)
+        return False
 
     def _record(self, name: str, **fields) -> None:
         if self.journal_sink is not None:
@@ -586,6 +622,10 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
         if chrome is not None:
             put("trace.json", chrome)
         put("config.json", self._config_fingerprint())
+        if self.remediation is not None:
+            # the action ledger as of THIS tick — the verdict hook ran
+            # before capture, so the bundle records what the page triggered
+            put("remediation.json", self.remediation.section())
         for fname, data in sorted(self._extra_bundle_files(st, snap).items()):
             put(fname, data)
         # manifest LAST — the commit point

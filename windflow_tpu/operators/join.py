@@ -157,6 +157,12 @@ class StreamTableJoin(Basic_Operator):
             cap = self._cap_resolved or DEFAULT_MAX_KEYS
             self._reserve = cap + pending
             self._hot_target = max(1, hot - self._reserve)
+            # actuator setpoint gauge (PR 17): the hot capacity this run was
+            # BUILT with — a traced constant, so remediation can only
+            # recommend a new one (last-write-wins across tables, the
+            # join_table_version convention)
+            from ..control import _state as _cstate
+            _cstate.set_gauge("hot_capacity", float(hot))
             outbox = int(self._tier_cfg.outbox or 4 * self._reserve)
             state = join_table_init(hot, pending, vspec)
             state = join_table_tier_init(state, outbox, vspec)

@@ -365,6 +365,10 @@ class Distinct(Basic_Operator):
             # distinct keys is the per-batch admission bound
             self._reserve = pending
             self._hot_target = max(1, hot - self._reserve)
+            # actuator setpoint gauge (PR 17): built-with hot capacity —
+            # last-write-wins, the join_table_version convention
+            from ..control import _state as _cstate
+            _cstate.set_gauge("hot_capacity", float(hot))
             outbox = int(self._tier_cfg.outbox or 4 * self._reserve)
             state = join_table_init(hot, pending, vspec)
             state = join_table_tier_init(state, outbox, vspec)

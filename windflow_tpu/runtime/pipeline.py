@@ -825,6 +825,25 @@ class Pipeline:
                                       lambda: tuner.capacity)
         if mon is not None and acc is not None:
             mon.registry.attach_gauge("dispatch_k", lambda: acc.k)
+        if mon is not None and mon.remediation is not None:
+            # bind the actuators THIS run owns (control/remediation.py):
+            # unbound actuators skip loudly.  scale_rate is lock-guarded;
+            # the re-climb request is an Event the drive loop consumes at
+            # its next on_batch boundary — both safe from the Reporter tick
+            if admission is not None:
+                mon.remediation.bind(
+                    "admission_rate",
+                    lambda a, _adm=admission: _adm.scale_rate(a.factor,
+                                                              a.floor))
+            if tuner is not None or ktuner is not None:
+                def _reclimb(_a, _t=tuner, _k=ktuner):
+                    names = []
+                    for t in (_t, _k):
+                        if t is not None:
+                            t.request_reclimb()
+                            names.append(t.name)
+                    return {"tuners": names}
+                mon.remediation.bind("autotune_reclimb", _reclimb)
         try:
             batches = (self.source.batches_prefetched(
                            self.batch_size, self.prefetch,

@@ -407,6 +407,18 @@ class ThreadedPipeline:
                 cfg, getattr(self.source, "out_capacity",
                              lambda b: b)(self.batch_size),
                 driver="threaded")
+        if (self._monitor is not None
+                and self._monitor.remediation is not None
+                and self._admission is not None):
+            # bind the actuators THIS run owns — remediation actions whose
+            # actuator stays unbound skip loudly (remediation_skip
+            # reason=unbound) instead of guessing.  scale_rate takes the
+            # bucket lock, so the Reporter-thread actuation is atomic
+            # w.r.t. the source thread's offer()
+            adm = self._admission
+            self._monitor.remediation.bind(
+                "admission_rate",
+                lambda a: adm.scale_rate(a.factor, a.floor))
         with _faults.activate(injector):
             try:
                 return self._run()
