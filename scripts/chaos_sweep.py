@@ -39,11 +39,21 @@ recovery back to OK — asserting the loop shape and that the incident bundle
 recorded the actions (lossy by design: admission sheds, so THIS leg asserts
 recovery, not byte-identity).
 
+--serve runs ONLY the serving closed-loop legs (one per seed): a
+ServingRuntime ingesting two tenants over a real loopback socket, with a
+seeded peer kill mid-stream (abrupt close, torn frame), garbage-byte
+injection, a full reconnect re-send (the dedup overlap), and a live
+graph hot-swap to a registered twin graph mid-stream — the outputs must
+be byte-identical to a RecordSource oracle fed the same chunks, with
+zero dropped committed tuples, >= 1 torn frame resync'd and >= 1
+duplicate frame deduped (the peer-kill-degrades-to-replay contract).
+
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --total 400
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --controller
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --dispatch 4
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 5 --shards 4
     JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 3 --remediate
+    JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --seeds 3 --serve
 """
 
 import argparse
@@ -258,6 +268,146 @@ def run_closed_loop(seed):
     return problems, len(applies), len(inj.fired)
 
 
+def run_serve_loop(seed, total=2000, chunk=50):
+    """The --serve acceptance: a ServingRuntime fed two tenants over a
+    real loopback socket, with a seeded mid-stream peer kill (abrupt
+    close), garbage injection, a full re-send on reconnect (the dedup
+    overlap), and a live hot-swap to a registered twin graph — outputs
+    must be byte-identical to a RecordSource oracle over the same chunks.
+    Returns (problems, counters)."""
+    import json
+    import shutil
+    import tempfile
+
+    from windflow_tpu.serving import (RecordClient, ServingRuntime,
+                                      SocketSource)
+
+    rng = np.random.RandomState(seed)
+    dt = np.dtype([("key", np.int32), ("ts", np.int64), ("v", np.float32)])
+    recs = np.zeros(total, dtype=dt)
+    recs["key"] = rng.randint(0, 8, total)
+    recs["ts"] = np.arange(total)
+    recs["v"] = rng.rand(total).astype(np.float32)
+    chunks = [recs[i:i + chunk] for i in range(0, total, chunk)]
+    # even chunks ride tenant "a", odd ones "b" — both unlimited, so the
+    # byte-identity claim covers the multi-tenant path with zero shedding
+    tenant_of = ["a" if i % 2 == 0 else "b" for i in range(len(chunks))]
+
+    def make_ops():
+        return [wf.Map(lambda t: {"v": t.v * 2.0 + 1.0})]
+
+    def collect_out(acc):
+        def cb(view):
+            if view is not None:
+                acc.extend(zip(view["id"].tolist(),
+                               np.asarray(view["payload"]["v"]).tolist()))
+        return cb
+
+    # oracle: the same chunks through a plain RecordSource pipeline
+    oracle = []
+    wf.Pipeline(wf.RecordSource(lambda: iter(chunks), dt, key_field="key",
+                                ts_field="ts", num_keys=8),
+                make_ops(), wf.Sink(collect_out(oracle)),
+                batch_size=chunk).run()
+
+    mon_dir = tempfile.mkdtemp(prefix="wf_chaos_serve_")
+    got = []
+    src = SocketSource("tcp://127.0.0.1:0", dt, key_field="key",
+                       ts_field="ts", num_keys=8, replay=len(chunks) + 8)
+    rt = ServingRuntime(
+        src, make_ops(), wf.Sink(collect_out(got)), batch_size=chunk,
+        serving={"tenants": [{"id": "a"}, {"id": "b"}]},
+        monitoring=mon_dir)
+    rt.register_graph("twin", make_ops())
+    src.start()                      # bind now: the client needs the port
+    thread = rt.run_background()
+
+    def decoded_stable():
+        # wait for the ingest side to drain a killed connection's kernel
+        # buffer before the overlap re-send, so chunk admission order
+        # stays the wire send order (the id-identity precondition)
+        last = -1
+        for _ in range(100):
+            cur = src.frames_decoded + src.frames_torn + src.frames_dup
+            if cur == last:
+                return
+            last = cur
+            time.sleep(0.05)
+
+    client = RecordClient(src.endpoint)
+    kill_at = int(rng.randint(len(chunks) // 4, 3 * len(chunks) // 4))
+    swap_at = kill_at // 2           # always before the kill: the swap
+    #                                  frame must survive the peer death
+    sent = {}                        # tenant -> [(seq, chunk_bytes)]
+    for i, c in enumerate(chunks[:kill_at]):
+        t = tenant_of[i]
+        seq = client.send(c.tobytes(), tenant=t)
+        sent.setdefault(t, []).append((seq, c.tobytes()))
+        if i == swap_at:
+            client.send_swap("twin")
+    client.send_garbage(b"TORN BYTES IN FLIGHT " * 3)
+    client.kill()                    # abrupt peer death, no EOS
+    decoded_stable()
+    client.reconnect()
+    # the client has no ack channel, so re-send EVERYTHING already sent
+    # (original seqs): the server drops the overlap as dup and admits only
+    # what the kill actually lost — replay, never loss or duplication
+    for t, frames in sent.items():
+        for seq, blob in frames:
+            client.send(blob, tenant=t, seq=seq)
+    for i in range(kill_at, len(chunks)):
+        t = tenant_of[i]
+        client.send(chunks[i].tobytes(), tenant=t)
+    client.send_eos("a")             # default eos policy: first eos ends it
+    client.close()
+    thread.join(timeout=60.0)
+
+    problems = []
+    if thread.is_alive():
+        problems.append("serving drive thread did not reach EOS")
+    if rt.background_error is not None:
+        problems.append(f"serving run raised "
+                        f"{type(rt.background_error).__name__}: "
+                        f"{rt.background_error}")
+    if sorted(got) != sorted(oracle):
+        missing = set(map(tuple, oracle)) - set(map(tuple, got))
+        extra = set(map(tuple, got)) - set(map(tuple, oracle))
+        problems.append(f"DIVERGED from the RecordSource oracle: "
+                        f"missing={len(missing)} extra={len(extra)}")
+    if src.frames_torn < 1:
+        problems.append("no torn frame — the garbage/kill injection never "
+                        "exercised resync")
+    if src.frames_dup < 1:
+        problems.append("no duplicate frame — the reconnect overlap never "
+                        "exercised dedup")
+    if rt.swaps_applied != 1:
+        problems.append(f"swaps_applied={rt.swaps_applied}, want 1 (the "
+                        f"wire-driven hot swap)")
+    if rt.graph_label != "twin":
+        problems.append(f"live graph is {rt.graph_label!r}, want 'twin'")
+    try:
+        with open(os.path.join(mon_dir, "snapshot.json")) as f:
+            snap = json.load(f)
+        srv = snap.get("serving") or {}
+        if srv.get("graph") != "twin":
+            problems.append("snapshot serving.graph did not record the swap")
+        tenants = srv.get("tenants") or {}
+        for t in ("a", "b"):
+            if t not in tenants:
+                problems.append(f"snapshot serving.tenants missing {t!r}")
+            elif tenants[t].get("shed", 0):
+                problems.append(f"tenant {t!r} shed "
+                                f"{tenants[t]['shed']} batch(es) — "
+                                f"unlimited tenants must never shed")
+    except (OSError, ValueError) as e:
+        problems.append(f"cannot read the serving snapshot: {e}")
+    counters = {"torn": src.frames_torn, "dup": src.frames_dup,
+                "decoded": src.frames_decoded, "kill_at": kill_at}
+    src.close()
+    shutil.rmtree(mon_dir, ignore_errors=True)
+    return problems, counters
+
+
 def plan_for(seed, threaded=False, shards=0):
     if threaded:
         # the threaded driver has no replay machinery: stalls only (delay,
@@ -307,7 +457,33 @@ def main():
                     "threaded closed-loop leg under queue.stall asserting "
                     "OK -> PAGE -> actuate -> recovery to OK with the "
                     "incident bundle recording the actions")
+    ap.add_argument("--serve", action="store_true",
+                    help="run ONLY the serving closed-loop legs (one per "
+                    "seed): two tenants over a real loopback socket, a "
+                    "seeded peer kill mid-stream + garbage + reconnect "
+                    "overlap + a live graph hot-swap — outputs must be "
+                    "byte-identical to a RecordSource oracle (zero loss, "
+                    "torn frames resync'd, overlap deduped)")
     args = ap.parse_args()
+    if args.serve:
+        failures = 0
+        for seed in range(args.seeds):
+            t0 = time.time()
+            problems, ctr = run_serve_loop(seed)
+            ok = not problems
+            print(f"[seed {seed}] serve: kill@chunk {ctr['kill_at']}, "
+                  f"{ctr['decoded']} decoded / {ctr['torn']} torn / "
+                  f"{ctr['dup']} dup, {'OK' if ok else 'FAILED'} "
+                  f"({time.time() - t0:.1f}s)")
+            for p in problems:
+                print(f"            {p}")
+            failures += bool(problems)
+        if failures:
+            print(f"FAIL: {failures} divergent serving run(s)")
+            return 1
+        print("PASS: all serving chaos runs byte-identical to the "
+              "RecordSource oracle")
+        return 0
     if args.shards and args.dispatch:
         ap.error("--shards excludes --dispatch on the supervised drivers "
                  "(WF115: a fused group failure has no single shard's "

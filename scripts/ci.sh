@@ -218,11 +218,29 @@ assert [e["event"] for e in rem["events"]] == \
         echo "ci: wf_slo.py --json remediation payload broke (rc=${rc})" >&2
         rm -rf "$tmp"; return 1
     fi
+    # serving front-door contracts: the WFS1 loopback selftest (framing +
+    # resync + per-tenant seq dedup, loaded by file path from
+    # windflow_tpu/serving) and the missing-inputs status contract — still
+    # without jax
+    PYTHONPATH="$tmp" python scripts/wf_serve.py selftest >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_serve.py selftest loopback broke (rc=${rc}, want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_serve.py status \
+        --monitoring-dir "$tmp/nope" >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 2 ]; then
+        echo "ci: wf_serve.py missing-inputs contract broke (rc=${rc}," \
+             "want 2)" >&2
+        rm -rf "$tmp"; return 1
+    fi
     rm -rf "$tmp"
     echo "stdlib CLI exit contracts ok (wf_slo 0/1/2 + remediation ledger,"
-    echo "wf_state/wf_health/wf_trace/wf_fleet/wf_top 2 on missing inputs,"
-    echo "fleet loopback selftest + wf_top/wf_slo over the aggregator dir;"
-    echo "all without jax)"
+    echo "wf_state/wf_health/wf_trace/wf_fleet/wf_top/wf_serve 2 on missing"
+    echo "inputs, fleet + serving loopback selftests, wf_top/wf_slo over"
+    echo "the aggregator dir; all without jax)"
 }
 run_step "stdlib CLIs" stdlib_cli_contracts
 

@@ -1,0 +1,375 @@
+#!/usr/bin/env python3
+"""wf_serve — serving front-door CLI (``windflow_tpu/serving``).
+
+The operator's tool for the serving plane: probe a front door without the
+compute plane, read a serving run's tenant/SWAP state from its monitoring
+artifacts, and drive a zero-downtime graph hot-swap over the wire.
+
+Subcommands:
+
+- ``serve``    — a standalone WFS1 frame sink on ``--listen``: accepts
+  clients, decodes record frames (magic + resync discipline, per-tenant
+  seq dedup), and prints per-tenant record/byte totals on SIGINT/EOS.
+  No JAX, no numpy: this is the producer-side debugging tool — point a
+  client at it and see exactly what a ``ServingRuntime`` would ingest::
+
+      python scripts/wf_serve.py serve --listen tcp://0.0.0.0:9910
+
+- ``status``   — one-shot read of a serving run's monitoring directory
+  (``snapshot.json``): live graph, swap counters, framing health, and the
+  per-tenant admit/shed table with tenant-labelled SLO states.
+- ``swap``     — send a ``swap`` control frame to a LIVE serving endpoint:
+  the runtime cuts over to the named registered graph at the next batch
+  boundary (``ServingRuntime.register_graph`` names the candidates)::
+
+      python scripts/wf_serve.py swap --endpoint tcp://host:9910 --graph v2
+
+- ``selftest`` — one-shot client→server loopback on an ephemeral endpoint:
+  two tenants, interleaved garbage and a duplicated seq, then EOS — proves
+  framing encode/decode, resync, and dedup end to end.  CI runs this under
+  a poisoned-JAX PYTHONPATH.
+
+Stdlib only (``windflow_tpu/serving/{framing,tenants}.py`` are loaded by
+file path — the ``wf_state.py`` convention), so every subcommand runs on a
+box without JAX or numpy installed.
+
+Exit codes: 0 = served/rendered/swapped/selftest passed, 2 =
+missing/unreadable inputs, bad endpoint, or a failed selftest
+(``scripts/ci.sh`` pins the contract).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_STATE = {0: "ok", 1: "warn", 2: "page"}
+
+
+def _load_serving(names=("framing", "tenants")):
+    """Load the serving helper modules by file path under a synthetic
+    package — no windflow_tpu package import, no JAX/numpy (the wf_slo.py
+    loader, pointed at ``windflow_tpu/serving``)."""
+    srv = os.path.join(REPO, "windflow_tpu", "serving")
+    pkg = sys.modules.get("wf_serving")
+    if pkg is None:
+        pkg = types.ModuleType("wf_serving")
+        pkg.__path__ = [srv]
+        sys.modules["wf_serving"] = pkg
+    for name in names:
+        if f"wf_serving.{name}" in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            f"wf_serving.{name}", os.path.join(srv, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"wf_serving.{name}"] = mod
+        spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return sys.modules["wf_serving.framing"]
+
+
+# ------------------------------------------------------------ serve
+
+
+class _FrameSink:
+    """A minimal WFS1 receiver: one decoder per client, per-tenant seq
+    dedup, per-tenant record/byte totals.  The producer-side contract
+    half of ``serving/sources.py::SocketSource`` — same framing, same
+    dedup rule, no compute plane behind it."""
+
+    def __init__(self, framing, endpoint):
+        self.framing = framing
+        kind, host, port = framing.parse_endpoint(endpoint)
+        if kind == "unix":
+            if os.path.exists(host):
+                os.unlink(host)
+            self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._srv.bind(host)
+            self.endpoint = endpoint
+        else:
+            self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind((host, port))
+            bhost, bport = self._srv.getsockname()[:2]
+            self.endpoint = f"tcp://{bhost}:{bport}"
+        self._srv.listen(8)
+        self._srv.settimeout(0.2)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.tenants = {}               # tid -> {frames, records_bytes, dup}
+        self.frames_torn = 0
+        self.swaps = []                 # graph labels seen in swap frames
+        self.eos = threading.Event()
+        self._last_seq = {}
+        self._threads = []
+
+    def _account(self, meta, blob):
+        tid = str(meta.get("tenant", self.framing.DEFAULT_TENANT))
+        kind = meta.get("kind", self.framing.KIND_DATA)
+        with self._lock:
+            row = self.tenants.setdefault(
+                tid, {"frames": 0, "records_bytes": 0, "dup": 0})
+            if kind == self.framing.KIND_SWAP:
+                self.swaps.append(meta.get("graph"))
+                return
+            seq = int(meta.get("seq", 0))
+            if seq <= self._last_seq.get(tid, -1):
+                row["dup"] += 1
+                return
+            self._last_seq[tid] = seq
+            if kind == self.framing.KIND_EOS:
+                self.eos.set()
+                return
+            row["frames"] += 1
+            row["records_bytes"] += len(blob)
+
+    def _client(self, conn):
+        dec = self.framing.RecordFrameDecoder()
+        conn.settimeout(0.2)
+        try:
+            while not self._stop.is_set():
+                try:
+                    data = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not data:
+                    break
+                for meta, blob in dec.feed(data):
+                    self._account(meta, blob)
+                with self._lock:
+                    self.frames_torn += dec.frames_torn
+                    dec.frames_torn = 0
+        finally:
+            conn.close()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._client, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def start(self):
+        t = threading.Thread(target=self._accept, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def report(self):
+        with self._lock:
+            return {"endpoint": self.endpoint, "frames_torn": self.frames_torn,
+                    "swaps": list(self.swaps),
+                    "tenants": {t: dict(r) for t, r in self.tenants.items()}}
+
+
+def cmd_serve(args) -> int:
+    framing = _load_serving()
+    try:
+        sink = _FrameSink(framing, args.listen)
+    except (ValueError, OSError) as e:
+        print(f"wf_serve: cannot listen on {args.listen!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    sink.start()
+    stop = []
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.append(1))
+    print(f"wf_serve: frame sink on {sink.endpoint} "
+          f"(WFS1 frames; ctrl-C or an eos frame to finish)", flush=True)
+    while not stop and not sink.eos.is_set():
+        time.sleep(0.2)
+    sink.stop()
+    rep = sink.report()
+    for tid in sorted(rep["tenants"]):
+        row = rep["tenants"][tid]
+        print(f"  tenant {tid}: {row['frames']} frame(s), "
+              f"{row['records_bytes']} record byte(s), {row['dup']} dup")
+    print(f"  torn: {rep['frames_torn']}  swap requests: "
+          f"{rep['swaps'] or '—'}")
+    return 0
+
+
+# ------------------------------------------------------------ status
+
+
+def cmd_status(args) -> int:
+    path = os.path.join(args.monitoring_dir, "snapshot.json")
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"wf_serve: cannot read {path!r}: {type(e).__name__}: {e}\n"
+              f"(run a ServingRuntime with monitoring on, or point "
+              f"--monitoring-dir at its out_dir)", file=sys.stderr)
+        return 2
+    srv = snap.get("serving") or {}
+    if args.json:
+        print(json.dumps(srv, indent=2, sort_keys=True))
+        return 0
+    if not srv:
+        print(f"wf_serve: {args.monitoring_dir!r} has no serving section "
+              f"(not a ServingRuntime run?)", file=sys.stderr)
+        return 2
+    print(f"serving @ {args.monitoring_dir!r}  graph={srv.get('graph', '?')}"
+          f"  swaps={srv.get('swaps_applied', 0)} "
+          f"(+{srv.get('swaps_rejected', 0)} rejected)"
+          + (f"  endpoint={srv['endpoint']}" if srv.get("endpoint") else ""))
+    if srv.get("frames_decoded") is not None:
+        print(f"  frames: {srv.get('frames_decoded', 0):g} decoded  "
+              f"{srv.get('frames_torn', 0):g} torn  "
+              f"{srv.get('frames_dup', 0):g} dup  "
+              f"clients={srv.get('clients_seen', 0):g}")
+    # worst tenant-labelled SLO state per tenant (the wf_top join)
+    worst = {}
+    for name, row in (snap.get("slo") or {}).items():
+        if isinstance(row, dict) and row.get("tenant") is not None:
+            code = row.get("code", 0) or 0
+            if code >= worst.get(row["tenant"], (-1, ""))[0]:
+                worst[row["tenant"]] = (code, name)
+    for tid in sorted(srv.get("tenants") or {}):
+        row = srv["tenants"][tid]
+        code, slo_name = worst.get(tid, (None, None))
+        state = _STATE.get(code, "—") if code is not None else "—"
+        rate = row.get("rate")
+        print(f"  tenant {tid:<14} offered={row.get('offered', 0):g} "
+              f"admitted={row.get('admitted', 0):g} "
+              f"shed={row.get('shed', 0):g} "
+              f"shed_tuples={row.get('shed_tuples', 0):g} "
+              f"rate={f'{rate:g}' if rate is not None else 'unlim'}  "
+              f"slo={state}{f' ({slo_name})' if slo_name else ''}")
+    return 0
+
+
+# ------------------------------------------------------------ swap
+
+
+def cmd_swap(args) -> int:
+    framing = _load_serving()
+    try:
+        client = framing.RecordClient(args.endpoint)
+        client.send_swap(args.graph)
+        client.close()
+    except (ValueError, OSError) as e:
+        print(f"wf_serve: cannot send swap to {args.endpoint!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    print(f"wf_serve: swap -> {args.graph!r} sent to {args.endpoint} "
+          f"(applies at the runtime's next batch boundary; unregistered "
+          f"labels count as swaps_rejected)")
+    return 0
+
+
+# ------------------------------------------------------------ selftest
+
+
+def cmd_selftest(args) -> int:
+    """Client→server loopback on an ephemeral endpoint: two tenants,
+    interleaved garbage bytes and one duplicated seq, then EOS.  Pins the
+    wire contract ``SocketSource`` relies on — without JAX or numpy."""
+    framing = _load_serving()
+    sink = _FrameSink(framing, "tcp://127.0.0.1:0")
+    sink.start()
+    try:
+        client = framing.RecordClient(sink.endpoint)
+        rec_a = bytes(range(24)) * 4          # fake fixed-width rows
+        rec_b = bytes(reversed(range(24))) * 2
+        client.send(rec_a, tenant="a")
+        client.send_garbage(b"NOISE " * 7)    # torn → resync at next magic
+        client.send(rec_b, tenant="b")
+        client.send(rec_a, tenant="a", seq=0)  # duplicate seq → dedup
+        client.send(rec_b, tenant="b")
+        client.send_eos("a")
+        client.close()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not sink.eos.is_set():
+            time.sleep(0.02)
+        rep = sink.report()
+    finally:
+        sink.stop()
+    ok = (sink.eos.is_set()
+          and rep["tenants"].get("a", {}).get("frames") == 1
+          and rep["tenants"].get("a", {}).get("dup") == 1
+          and rep["tenants"].get("b", {}).get("frames") == 2
+          and rep["tenants"].get("a", {}).get("records_bytes") == len(rec_a)
+          and rep["tenants"].get("b", {}).get("records_bytes")
+          == 2 * len(rec_b)
+          and rep["frames_torn"] >= 1)
+    if args.json:
+        print(json.dumps({"ok": ok, **rep}, indent=2, sort_keys=True))
+    else:
+        print(f"wf_serve selftest: {'OK' if ok else 'FAILED'} — "
+              f"{json.dumps(rep['tenants'], sort_keys=True)} "
+              f"torn={rep['frames_torn']}")
+    return 0 if ok else 2
+
+
+# ------------------------------------------------------------ main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_serve",
+        description="serving front-door CLI: standalone frame sink, "
+                    "serving-run status, wire-driven graph hot-swap, "
+                    "loopback selftest")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("serve", help="standalone WFS1 frame sink (no JAX)")
+    p.add_argument("--listen", default="tcp://127.0.0.1:0",
+                   help="endpoint to bind (tcp://HOST:PORT, port 0 = "
+                        "ephemeral, or unix:///path.sock)")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("status",
+                       help="render a serving run's tenant/swap state")
+    p.add_argument("--monitoring-dir", default="wf_monitoring",
+                   help="the ServingRuntime's monitoring out_dir")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw serving section as JSON")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("swap",
+                       help="send a graph hot-swap control frame to a "
+                            "live serving endpoint")
+    p.add_argument("--endpoint", required=True,
+                   help="the ServingRuntime's SocketSource endpoint")
+    p.add_argument("--graph", required=True,
+                   help="registered graph label to cut over to")
+    p.set_defaults(fn=cmd_swap)
+
+    p = sub.add_parser("selftest",
+                       help="loopback framing/dedup/resync selftest "
+                            "(ephemeral endpoint, no JAX/numpy)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_selftest)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

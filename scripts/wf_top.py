@@ -15,6 +15,10 @@ one-screen view every ``--interval`` seconds:
   host-tag the keys, so the view names WHICH host's shard);
 - **SLOs** — per-SLO OK/WARN/PAGE with fast/slow burn and a burn trend
   sparkline over the recent ticks;
+- **serving** — the serving front door (``windflow_tpu/serving``): live
+  graph + hot-swap counters, socket framing health, and one row per
+  tenant (admit/shed counters, bucket rate, worst tenant-labelled SLO
+  state — a paging tenant is flagged on the line naming its shed rate);
 - **remediation** — actuator setpoint gauges (admission tps, governor
   watermarks, tiered hot_capacity and its recommended value) + the
   self-driving engine's last-action ledger, when the run had
@@ -270,6 +274,59 @@ def slo_panel(snap, series):
     return lines
 
 
+def serving_panel(snap, series):
+    """The serving front door at a glance: which graph is live (and how
+    many hot-swaps got it there), the socket framing health, and one row
+    per tenant — admit/shed counters, the bucket's current rate (the knob
+    tenant_rate remediation turns), and that tenant's worst SLO state
+    (joined on the per-SLO rows' ``tenant`` label, so a paging tenant is
+    flagged on the same line as its shed counters)."""
+    srv = snap.get("serving") or {}
+    if not srv:
+        return None
+    lines = ["== serving =="]
+    lines.append(
+        f"  graph={srv.get('graph', '?')}  "
+        f"swaps={srv.get('swaps_applied', 0)} "
+        f"(+{srv.get('swaps_rejected', 0)} rejected)"
+        + (f"  endpoint={srv['endpoint']}" if srv.get("endpoint") else "")
+        + (f"  clients={srv['clients_seen']:g}"
+           if srv.get("clients_seen") is not None else ""))
+    if srv.get("frames_decoded") is not None:
+        lines.append(
+            f"  frames: {srv.get('frames_decoded', 0):g} decoded  "
+            f"{srv.get('frames_torn', 0):g} torn  "
+            f"{srv.get('frames_dup', 0):g} dup"
+            + (f"  (+{srv['unknown_offered']:g} from unknown tenants)"
+               if srv.get("unknown_offered") else ""))
+    tenants = srv.get("tenants") or {}
+    if tenants:
+        # worst SLO state per tenant, from the per-SLO rows' tenant label
+        worst = {}
+        for name, row in (snap.get("slo") or {}).items():
+            if not isinstance(row, dict) or row.get("tenant") is None:
+                continue
+            code = row.get("code", 0) or 0
+            t = row["tenant"]
+            if code >= worst.get(t, (-1, ""))[0]:
+                worst[t] = (code, name)
+        lines.append(f"  {'tenant':<14} {'offered':>8} {'admitted':>9} "
+                     f"{'shed':>6} {'tuples shed':>11} {'rate':>8}  slo")
+        for tid in sorted(tenants):
+            row = tenants[tid]
+            code, slo_name = worst.get(tid, (None, None))
+            state = _STATE.get(code, "—") if code is not None else "—"
+            flag = {"page": "  [PAGE]", "warn": "  [WARN]"}.get(state, "")
+            rate = row.get("rate")
+            lines.append(
+                f"  {tid:<14} {row.get('offered', 0):>8g} "
+                f"{row.get('admitted', 0):>9g} {row.get('shed', 0):>6g} "
+                f"{row.get('shed_tuples', 0):>11g} "
+                f"{(f'{rate:g}' if rate is not None else 'unlim'):>8}  "
+                f"{state}{f' ({slo_name})' if slo_name else ''}{flag}")
+    return lines
+
+
 def remediation_panel(snap):
     """The self-driving loop at a glance: actuator setpoint gauges (where
     the knobs currently sit) + the engine's last-action ledger."""
@@ -346,8 +403,8 @@ def render(dh, mon_dir) -> str:
     blocks = [header(snap, series, mon_dir), stages_panel(snap, series),
               queues_panel(snap)]
     for panel in (event_time_panel(snap), shards_panel(snap),
-                  slo_panel(snap, series), remediation_panel(snap),
-                  hbm_panel(snap)):
+                  slo_panel(snap, series), serving_panel(snap, series),
+                  remediation_panel(snap), hbm_panel(snap)):
         if panel:
             blocks.append(panel)
     return "\n\n".join("\n".join(b) for b in blocks)

@@ -1,0 +1,499 @@
+"""Serving front-end (PR 18): WFS1 framing (resync on torn/garbage bytes,
+length-lie rejection, endpoint grammar), SocketSource over a REAL TCP socket
+(per-tenant seq dedup, peer-kill + overlap re-send degrading to replay, the
+supervised replay ring's gap re-drive and loud under-sized refusal),
+FileTailSource, ServingRuntime (tenant isolation — a noisy tenant sheds
+under ITS bucket while the quiet tenant is never touched; live graph
+hot-swap under load staying oracle-exact; wire-swap rejection), the WF119
+validator + constructor mirror, the gauge/help lockstep, tenant-labelled
+SLO signals, and the wf_serve CLI contract."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.analysis import validate
+from windflow_tpu.observability import slo as slo_mod
+from windflow_tpu.observability.names import (JOURNAL_EVENTS, SERVING_GAUGES,
+                                              TENANT_GAUGES)
+from windflow_tpu.observability import metrics as metrics_mod
+from windflow_tpu.serving import (FileTailSource, RecordClient,
+                                  RecordFrameDecoder, ServingConfig,
+                                  ServingRuntime, SocketSource, TenantSpec,
+                                  encode_record_frame)
+from windflow_tpu.serving import framing as framing_mod
+from windflow_tpu.serving.config import serving_problems
+from windflow_tpu.serving.tenants import (build_registry, registry_problems,
+                                          resolve_tenants)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BATCH = 32
+DT = np.dtype([("key", np.int32), ("ts", np.int64), ("v", np.float32)])
+
+
+def _chunks(n, base=0.0, batch=BATCH):
+    out = []
+    for i in range(n):
+        rec = np.zeros(batch, dtype=DT)
+        rec["key"] = np.arange(batch) % 4
+        rec["ts"] = np.arange(i * batch, (i + 1) * batch)
+        rec["v"] = base + np.arange(i * batch, (i + 1) * batch,
+                                    dtype=np.float32)
+        out.append(rec)
+    return out
+
+
+def _ops():
+    return [wf.Map(lambda t: {"v": t.v * 2.0 + 1.0})]
+
+
+def _collect(acc):
+    def cb(view):
+        if view is not None:
+            acc.extend(zip(view["id"].tolist(),
+                           np.asarray(view["payload"]["v"]).tolist()))
+    return cb
+
+
+def _oracle(chunks):
+    out = []
+    wf.Pipeline(wf.RecordSource(lambda: iter(chunks), DT, key_field="key",
+                                ts_field="ts", num_keys=4),
+                _ops(), wf.Sink(_collect(out)), batch_size=BATCH).run()
+    return out
+
+
+# ---------------------------------------------------------------- framing
+
+
+def test_frame_roundtrip_byte_by_byte():
+    rec = _chunks(1)[0].tobytes()
+    wire = encode_record_frame(rec, tenant="a", seq=7)
+    dec = RecordFrameDecoder()
+    got = []
+    for i in range(len(wire)):          # worst-case torn delivery
+        got += dec.feed(wire[i:i + 1])
+    assert len(got) == 1
+    meta, blob = got[0]
+    assert meta["tenant"] == "a" and meta["seq"] == 7 \
+        and meta["kind"] == "data" and meta["nbytes"] == len(rec)
+    assert blob == rec
+    assert dec.frames_decoded == 1 and dec.frames_torn == 0
+
+
+def test_decoder_resyncs_through_garbage_and_truncation():
+    rec = _chunks(1)[0].tobytes()
+    a = encode_record_frame(rec, tenant="a", seq=0)
+    b = encode_record_frame(rec, tenant="b", seq=0)
+    # garbage, an intact frame, a frame cut mid-payload, another intact one
+    wire = b"NOT A FRAME " * 4 + a + b[:len(b) // 2] + a[:10] + b
+    dec = RecordFrameDecoder()
+    got = dec.feed(wire)
+    assert [m["tenant"] for m, _ in got] == ["a", "b"]
+    assert all(blob == rec for _, blob in got)
+    assert dec.frames_torn >= 2
+
+
+def test_decoder_rejects_lying_nbytes_then_recovers():
+    rec = b"x" * 40
+    liar = bytearray(encode_record_frame(rec, tenant="a", seq=0))
+    # corrupt the meta's nbytes without touching the frame length
+    liar = bytes(liar).replace(b'"nbytes": 40', b'"nbytes": 39')
+    good = encode_record_frame(rec, tenant="b", seq=0)
+    dec = RecordFrameDecoder()
+    got = dec.feed(liar + good)
+    assert [m["tenant"] for m, _ in got] == ["b"]
+    assert dec.frames_torn == 1
+
+
+def test_parse_endpoint_grammar():
+    pe = framing_mod.parse_endpoint
+    assert pe("tcp://127.0.0.1:9500") == ("tcp", "127.0.0.1", 9500)
+    assert pe("127.0.0.1:0") == ("tcp", "127.0.0.1", 0)
+    assert pe("unix:///tmp/wf.sock") == ("unix", "/tmp/wf.sock")
+    assert pe("unix:/tmp/wf.sock") == ("unix", "/tmp/wf.sock")
+    for bad in ("", "tcp://nohost", "tcp://h:notaport", "tcp://h:99999",
+                "unix://"):
+        with pytest.raises(ValueError):
+            pe(bad)
+
+
+# ----------------------------------------------------------- socket source
+
+
+def _drain(src, out):
+    """Consume src.batches on this thread into out (chunk value lists)."""
+    for b in src.batches(BATCH):
+        v = np.asarray(b.payload["v"])[np.asarray(b.valid)]
+        out.append((src.last_tenant, v.tolist()))
+
+
+def test_socket_source_dedup_torn_and_eos():
+    chunks = _chunks(3)
+    src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                       ts_field="ts", num_keys=4).start()
+    client = RecordClient(src.endpoint)
+    client.send(chunks[0].tobytes(), tenant="a")
+    client.send_garbage(b"GARBAGE IN THE STREAM " * 3)
+    client.send(chunks[1].tobytes(), tenant="b")
+    client.send(chunks[0].tobytes(), tenant="a", seq=0)   # dup: dropped
+    client.send(chunks[2].tobytes(), tenant="a")
+    client.send_eos("a")
+    client.close()
+    got = []
+    _drain(src, got)
+    src.close()
+    assert [t for t, _ in got] == ["a", "b", "a"]
+    assert got[0][1] == chunks[0]["v"].tolist()
+    assert got[2][1] == chunks[2]["v"].tolist()
+    assert src.frames_dup == 1 and src.frames_torn >= 1
+    assert src.clients_seen == 1
+
+
+def test_peer_kill_overlap_resend_degrades_to_replay():
+    chunks = _chunks(6)
+    src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                       ts_field="ts", num_keys=4, replay=32).start()
+    client = RecordClient(src.endpoint)
+    sent = []
+    for c in chunks[:3]:
+        sent.append((client.send(c.tobytes(), tenant="a"), c.tobytes()))
+    client.kill()
+    # wait for the killed connection's thread to finish draining
+    last = -1
+    for _ in range(100):
+        cur = src.frames_decoded + src.frames_torn + src.frames_dup
+        if cur == last:
+            break
+        last = cur
+        time.sleep(0.05)
+    client.reconnect()
+    for seq, blob in sent:              # unacked-tail re-send: all overlap
+        client.send(blob, tenant="a", seq=seq)
+    for c in chunks[3:]:
+        client.send(c.tobytes(), tenant="a")
+    client.send_eos("a")
+    client.close()
+    got = []
+    _drain(src, got)
+    src.close()
+    assert [v for _, v in got] == [c["v"].tolist() for c in chunks]
+    assert src.frames_dup >= 1          # the overlap was deduped, not lost
+
+
+def test_replay_ring_resume_redrives_gap_and_refuses_undersized():
+    chunks = _chunks(5)
+    src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                       ts_field="ts", num_keys=4, replay=8).start()
+    client = RecordClient(src.endpoint)
+    for c in chunks:
+        client.send(c.tobytes(), tenant="a")
+    client.send_eos("a")
+    client.close()
+    # let all frames land in the ring before resuming
+    for _ in range(200):
+        with src._lock:
+            if src._next_chunk == len(chunks):
+                break
+        time.sleep(0.01)
+    got = [rec["v"].tolist() for rec in src._chunks_from_ring(from_batch=2)]
+    assert got == [c["v"].tolist() for c in chunks[2:]]
+    src.close()
+
+    tight = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                         ts_field="ts", num_keys=4, replay=2).start()
+    client = RecordClient(tight.endpoint)
+    for c in chunks:
+        client.send(c.tobytes(), tenant="a")
+    client.send_eos("a")
+    client.close()
+    for _ in range(200):
+        with tight._lock:
+            if tight._next_chunk == len(chunks):
+                break
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError, match="replay ring starts at"):
+        next(tight._chunks_from_ring(from_batch=1))
+    tight.close()
+
+
+def test_file_tail_source_follows_appends(tmp_path):
+    chunks = _chunks(4)
+    path = str(tmp_path / "records.bin")
+    open(path, "wb").close()
+
+    def writer():
+        with open(path, "ab") as f:
+            for c in chunks:
+                f.write(c.tobytes())
+                f.flush()
+                time.sleep(0.02)
+        open(path + ".eos", "w").close()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    got = []
+    src = FileTailSource(path, DT, batch_records=BATCH, key_field="key",
+                         ts_field="ts", num_keys=4, poll_s=0.005)
+    wf.Pipeline(src, _ops(), wf.Sink(_collect(got)), batch_size=BATCH).run()
+    t.join()
+    assert sorted(got) == sorted(_oracle(chunks)) and got
+
+
+# ---------------------------------------------------------------- runtime
+
+
+def _serve(tmp_path, tenants, chunks, tenant_of, *, swap=None,
+           eos_tenant="a", register=("v2",)):
+    mon = str(tmp_path / "mon")
+    got = []
+    src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                       ts_field="ts", num_keys=4, replay=len(chunks) + 8)
+    rt = ServingRuntime(src, _ops(), wf.Sink(_collect(got)),
+                        batch_size=BATCH, serving={"tenants": tenants},
+                        monitoring=mon)
+    for label in register:
+        rt.register_graph(label, _ops())
+    src.start()
+    thread = rt.run_background()
+    client = RecordClient(src.endpoint)
+    for i, c in enumerate(chunks):
+        client.send(c.tobytes(), tenant=tenant_of[i])
+        if swap is not None and i == swap[0]:
+            client.send_swap(swap[1])
+    client.send_eos(eos_tenant)
+    client.close()
+    thread.join(timeout=60.0)
+    assert not thread.is_alive()
+    if rt.background_error is not None:
+        raise rt.background_error
+    return got, rt, mon
+
+
+def test_live_swap_under_load_is_oracle_exact(tmp_path):
+    chunks = _chunks(16)
+    tenant_of = ["a" if i % 2 == 0 else "b" for i in range(len(chunks))]
+    got, rt, mon = _serve(tmp_path, [{"id": "a"}, {"id": "b"}], chunks,
+                          tenant_of, swap=(len(chunks) // 2, "v2"))
+    assert rt.swaps_applied == 1 and rt.graph_label == "v2"
+    assert sorted(got) == sorted(_oracle(chunks)) and got
+    # the cutover is a journaled graph_swap with warm-before-cut recorded
+    events = [json.loads(line)
+              for line in open(os.path.join(mon, "events.jsonl"))]
+    swaps = [e for e in events
+             if e.get("event") == "graph_swap" and e.get("applied")]
+    assert len(swaps) == 1
+    assert swaps[0]["warmed"] is True and swaps[0]["carried_state"] is True
+    # and the snapshot's serving section reflects the post-cut world
+    snap = json.load(open(os.path.join(mon, "snapshot.json")))
+    assert snap["serving"]["graph"] == "v2"
+    assert snap["serving"]["swaps_applied"] == 1
+    assert set(snap["serving"]["tenants"]) == {"a", "b"}
+
+
+def test_wire_swap_to_unregistered_graph_is_rejected(tmp_path):
+    chunks = _chunks(4)
+    got, rt, _ = _serve(tmp_path, [{"id": "a"}], chunks, ["a"] * 4,
+                        swap=(1, "nope"))
+    assert rt.swaps_rejected == 1 and rt.swaps_applied == 0
+    assert rt.graph_label != "nope"
+    assert sorted(got) == sorted(_oracle(chunks))   # traffic unharmed
+
+
+def test_noisy_tenant_sheds_under_its_own_bucket_only(tmp_path):
+    quiet = _chunks(12, base=10_000.0)
+    noisy = _chunks(12, base=0.0)
+    mixed, tenant_of = [], []
+    for q, n in zip(quiet, noisy):
+        mixed += [q, n]
+        tenant_of += ["quiet", "noisy"]
+    got, rt, _ = _serve(
+        tmp_path,
+        [{"id": "quiet"},
+         {"id": "noisy", "refill_per_batch": 4.0, "burst": float(BATCH)}],
+        mixed, tenant_of, eos_tenant="quiet")
+    rows = rt.serving_section()["tenants"]
+    assert rows["noisy"]["shed"] > 0 and rows["noisy"]["shed_tuples"] > 0
+    # the isolation contract: the quiet tenant NEVER sheds — its
+    # drop_ratio signal stays exactly 0 while its neighbor burns
+    assert rows["quiet"]["shed"] == 0 and rows["quiet"]["shed_tuples"] == 0
+    quiet_vals = [v for _, v in got if v >= 2 * 10_000]
+    assert len(quiet_vals) == sum(len(c) for c in quiet)
+
+
+def test_registry_scale_rate_targets_one_tenant():
+    reg = build_registry(
+        [{"id": "a", "refill_per_batch": 8.0}, {"id": "b"}],
+        base_capacity=BATCH)
+    out = reg.scale_rate("a", 0.5)
+    assert out["tenant"] == "a"
+    with pytest.raises(ValueError):
+        reg.scale_rate("b", 0.5)        # declared but rate-unlimited
+    with pytest.raises(ValueError):
+        reg.scale_rate("ghost", 0.5)
+
+
+# ------------------------------------------------------ config + validator
+
+
+def test_serving_config_resolve_grammar(monkeypatch, tmp_path):
+    monkeypatch.delenv("WF_SERVE", raising=False)
+    assert ServingConfig.resolve(None) is None
+    assert ServingConfig.resolve(False) is None
+    assert ServingConfig.resolve(True).replay == 256
+    assert ServingConfig.resolve("tcp://h:5").endpoint == "tcp://h:5"
+    assert ServingConfig.resolve('{"replay": 9}').replay == 9
+    p = tmp_path / "s.json"
+    p.write_text('{"endpoint": "tcp://h:5", "swap_warm": false}')
+    cfg = ServingConfig.resolve(str(p))
+    assert cfg.endpoint == "tcp://h:5" and cfg.swap_warm is False
+    monkeypatch.setenv("WF_SERVE", "0")
+    assert ServingConfig.resolve(None) is None
+    monkeypatch.setenv("WF_SERVE", "1")
+    assert ServingConfig.resolve(None) is not None
+    monkeypatch.setenv("WF_SERVE_ENDPOINT", "tcp://e:7")
+    assert ServingConfig.resolve(None).resolved_endpoint() == "tcp://e:7"
+
+
+def test_serving_problems_catalogue(tmp_path):
+    mon = str(tmp_path / "mon")
+    ok = ServingConfig(tenants=[{"id": "a"}])
+    assert serving_problems(ok, monitoring=mon) == []
+    # monitoring off: the whole plane is unobservable
+    assert any("monitoring" in p
+               for p in serving_problems(ok, monitoring=None))
+    # endpoint, replay, swap_warm, duplicate tenants, supervised wall-clock
+    probs = serving_problems(
+        ServingConfig(endpoint="not an endpoint", replay=0, swap_warm=False,
+                      tenants=[{"id": "a"}, {"id": "a"},
+                               {"id": "b", "rate_tps": 5.0}]),
+        monitoring=mon, supervised=True)
+    blob = "\n".join(probs)
+    assert "unparseable serving endpoint" in blob
+    assert "replay must be >= 1" in blob
+    assert "swap_warm=false" in blob
+    assert "duplicate tenant id" in blob.lower() or "duplicate" in blob
+    assert "rate_tps" in blob           # wall-clock bucket under supervision
+    # an SLO tenant label must name a declared tenant
+    spec = slo_mod.SLOSpec("iso", "tenant_drop_ratio", target=0.1,
+                           tenant="ghost")
+    probs = serving_problems(ok, monitoring=mon, slo_specs=[spec])
+    assert any("ghost" in p for p in probs)
+
+
+def test_constructor_mirrors_wf119(tmp_path):
+    mon = str(tmp_path / "mon")
+    src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                       ts_field="ts", num_keys=4)
+    with pytest.raises(ValueError, match="WF119"):
+        ServingRuntime(src, _ops(), serving=True)           # monitoring off
+    with pytest.raises(ValueError, match="WF119"):
+        ServingRuntime(src, _ops(), monitoring=mon,
+                       serving={"tenants": [{"id": "a"}, {"id": "a"}]})
+    with pytest.raises(ValueError, match="WF119"):
+        ServingRuntime(src, _ops(), monitoring=mon, supervised=True,
+                       serving={"tenants": [{"id": "a", "rate_tps": 9.0}]})
+    src.close()
+
+
+def test_validator_reports_wf119(monkeypatch, tmp_path):
+    mon = str(tmp_path / "mon")
+    src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                       ts_field="ts", num_keys=4)
+    rt = ServingRuntime(src, _ops(), wf.Sink(lambda v: None),
+                        batch_size=BATCH, monitoring=mon,
+                        serving={"tenants": [{"id": "a"}]})
+    rt.register_graph("v2", _ops())
+    report = validate(rt)               # a ServingRuntime validates directly
+    assert "WF119" not in report.codes()
+    assert not report.errors
+    src.close()
+    # classic drivers resolve the env exactly as the runtime would:
+    # WF_SERVE on + monitoring off is flagged pre-run
+    monkeypatch.setenv("WF_SERVE", "1")
+    chunks = _chunks(2)
+    p = wf.Pipeline(wf.RecordSource(lambda: iter(chunks), DT,
+                                    key_field="key", ts_field="ts",
+                                    num_keys=4),
+                    _ops(), wf.Sink(lambda v: None), batch_size=BATCH)
+    report = validate(p)
+    assert "WF119" in report.codes()
+
+
+def test_tenant_grammar_and_registry_problems():
+    specs = resolve_tenants('[{"id": "a", "refill_per_batch": 2}]')
+    assert specs[0].id == "a" and specs[0].refill_per_batch == 2.0
+    assert resolve_tenants(None) is None
+    # legality is registry_problems/build_registry territory, not resolve
+    both = resolve_tenants([{"id": "a", "rate_tps": 1.0,
+                             "refill_per_batch": 1.0}])
+    assert any("mutually exclusive" in p for p in registry_problems(both))
+    with pytest.raises(ValueError, match="WF119"):
+        build_registry(both, base_capacity=BATCH)
+    probs = registry_problems([TenantSpec("a", rate_tps=5.0)],
+                              supervised=True)
+    assert probs and "rate_tps" in probs[0]
+
+
+# ----------------------------------------------------- observability glue
+
+
+def test_gauge_help_lockstep():
+    assert set(metrics_mod._SERVING_HELP) == set(SERVING_GAUGES)
+    assert set(metrics_mod._TENANT_HELP) == set(TENANT_GAUGES)
+    for ev in ("serving_start", "serving_end", "graph_swap"):
+        assert ev in JOURNAL_EVENTS
+
+
+def test_tenant_slo_signals_read_tenant_rows():
+    def snap(offered, shed, shed_tuples):
+        return {"serving": {"tenants": {"a": {"offered": offered,
+                                              "shed": shed,
+                                              "shed_tuples": shed_tuples}}}}
+    fn, mode = slo_mod.TENANT_SIGNALS["tenant_drop_ratio"]
+    assert mode == "max"
+    assert fn(snap(10, 5, 160), snap(0, 0, 0), "a") == pytest.approx(0.5)
+    assert fn(snap(10, 5, 160), snap(10, 5, 160), "a") is None  # no traffic
+    assert fn(snap(10, 5, 160), snap(0, 0, 0), "ghost") is None
+    fn2, _ = slo_mod.TENANT_SIGNALS["tenant_shed_tuples"]
+    assert fn2(snap(10, 5, 160), snap(8, 3, 100), "a") == 60.0
+    # a tenant signal without tenant= (and vice versa) is a spec problem
+    bad = slo_mod.SLOSpec("x", "tenant_drop_ratio", target=0.1)
+    assert any("tenant" in p for p in slo_mod.spec_problems(bad))
+    bad2 = slo_mod.SLOSpec("y", "drop_ratio", target=0.1, tenant="a")
+    assert any("tenant" in p for p in slo_mod.spec_problems(bad2))
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_wf_serve_cli_contract(tmp_path):
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "wf_serve.py"),
+         "selftest"], capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "wf_serve.py"),
+         "status", "--monitoring-dir", str(tmp_path / "nope")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 2
+
+
+def test_wf_serve_status_renders_live_run(tmp_path):
+    chunks = _chunks(4)
+    got, rt, mon = _serve(tmp_path, [{"id": "a"}], chunks, ["a"] * 4)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "wf_serve.py"),
+         "status", "--monitoring-dir", mon, "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["tenants"]["a"]["offered"] == 4

@@ -59,6 +59,8 @@ Every function is classified by the set of ROLES it can run on:
 - ``prefetch``        — the double-buffered H2D ingest worker
 - ``telemetry``       — fleet telemetry plane threads (agent sender,
   aggregator accept/reader/ticker)
+- ``ingest``          — serving front-door network threads (SocketSource
+  accept loop + per-client frame decoders)
 - ``native``          — short-lived native record-framing workers
 - ``thread``          — an UNANNOTATED spawned thread (unknown worker)
 
@@ -118,7 +120,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 # ------------------------------------------------------------------ grammar
 
 ROLES = ("driver", "stage", "reporter", "watchdog", "checkpoint-pool",
-         "jax-callback", "prefetch", "telemetry", "native", "thread")
+         "jax-callback", "prefetch", "telemetry", "ingest", "native",
+         "thread")
 
 #: default role a spawn seeds when the spawn line carries no annotation
 DEFAULT_THREAD_ROLE = "thread"

@@ -33,6 +33,13 @@ WF118  error     remediation config the run cannot honor (a
                  an action naming an actuator the run config does not
                  own, a sub-tick cooldown, or a non-barrier actuator
                  under the supervised drivers
+WF119  error     serving config the run cannot honor (a
+                 validate()-time code, registered in RULES for
+                 --explain/--select): serving on while monitoring
+                 resolves off, an unparseable endpoint, duplicate
+                 tenant ids, wall-clock tenant buckets under
+                 supervision, replay < 1, ``swap_warm=False``, or an
+                 SLO ``tenant=`` label naming an undeclared tenant
 WF200  error     scanned file fails to parse (the linter cannot see it)
 WF201  error     ``WF_*`` env read missing from ``docs/ENV_FLAGS.md``
 WF202  error     ENV_FLAGS.md row does not state WHEN the flag is read
@@ -119,6 +126,15 @@ RULES: Dict[str, Tuple[str, str]] = {
                        "unresolvable policy, unowned actuator, "
                        "sub-tick cooldown, non-barrier actuator under "
                        "supervision)"),
+    # WF119 is likewise validate()-time (validate.py::_check_serving,
+    # sharing serving/config.py::serving_problems with the ServingRuntime
+    # constructor)
+    "WF119": ("error", "serving config the run cannot honor "
+                       "(WF_SERVE while monitoring off, unparseable "
+                       "endpoint, duplicate tenant ids, wall-clock "
+                       "tenant buckets under supervision, replay < 1, "
+                       "swap_warm=false, SLO tenant= label naming an "
+                       "undeclared tenant)"),
     "WF200": ("error", "scanned file fails to parse (the linter cannot "
                        "see it)"),
     "WF201": ("error", "WF_* env read missing from docs/ENV_FLAGS.md"),

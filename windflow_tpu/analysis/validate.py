@@ -99,6 +99,20 @@ WF118  error     remediation config the run cannot honor
                  the supervised drivers — an action whose actuator has
                  no deterministic barrier signal (replay could not
                  re-derive it)
+WF119  error     serving config the run cannot honor
+                 (``serving/config.py``): serving on (``serving=``/
+                 ``WF_SERVE``) while monitoring itself resolves off
+                 (tenant counters, per-tenant SLOs, and ``graph_swap``
+                 spans all live in the monitoring snapshot/journal),
+                 an endpoint that does not parse, a tenant set that
+                 does not resolve / duplicate tenant ids, wall-clock
+                 tenant buckets (``rate_tps``) under supervision (the
+                 WF105 mirror — shed decisions would not replay),
+                 ``replay`` < 1, ``swap_warm=False`` (the incoming
+                 chain would compile inside the swap quiesce, stalling
+                 live traffic), or an SLO spec whose ``tenant=`` label
+                 names an undeclared tenant (the SLO idles at OK
+                 forever)
 WF114  warn/err  tiered keyed state (``windflow_tpu/state``) combined
                  with a configuration its determinism/sizing contract
                  cannot honor: sequence-id tracing or wall-clock
@@ -925,6 +939,49 @@ def _check_remediation_supervised(report, sp) -> None:
                      "radius, like slo_max_incidents bounds bundles")
 
 
+def _check_serving(report, stored_serving, stored_monitoring,
+                   supervised) -> None:
+    """WF119: the serving mirror of WF116/117 — resolve the serving config
+    exactly as ``ServingRuntime`` will (``serving=`` argument, else
+    ``WF_SERVE``/``WF_SERVE_ENDPOINT``/``WF_TENANTS``) and reject
+    configurations the serving plane cannot honor before the run starts
+    (the runtime raises the same problems at construction; this surfaces
+    them pre-run with the operator-path/hint shape)."""
+    from ..serving.config import ServingConfig, serving_problems
+    try:
+        cfg = ServingConfig.resolve(stored_serving)
+    except (ValueError, TypeError, OSError) as e:
+        report.add(
+            "WF119", "error", "serving",
+            f"serving config does not resolve: {type(e).__name__}: {e}",
+            hint="serving=/WF_SERVE accept True/'1' (defaults), an endpoint "
+                 "string ('tcp://HOST:PORT' / 'unix:///path.sock'), a "
+                 "ServingConfig/dict, a JSON file path, or inline JSON "
+                 "({endpoint, tenants, swap_warm, replay})")
+        return
+    if cfg is None:
+        return
+    slo_specs = None
+    try:
+        from ..observability import MonitoringConfig
+        from ..observability import slo as _slo
+        mcfg = MonitoringConfig.resolve(stored_monitoring)
+        if mcfg is not None:
+            slo_specs = _slo.resolve_specs(mcfg.slo)
+    except (ValueError, TypeError, OSError):
+        slo_specs = None                # already diagnosed as WF113/WF116
+    for prob in serving_problems(cfg, monitoring=stored_monitoring,
+                                 supervised=supervised,
+                                 slo_specs=slo_specs):
+        report.add(
+            "WF119", "error", "serving", prob,
+            hint="the serving plane rides monitoring for per-tenant SLOs "
+                 "and remediation: tenant ids must be unique, supervised "
+                 "buckets deterministic (refill_per_batch, not rate_tps), "
+                 "swaps warmed (swap_warm=True), and every slo tenant= "
+                 "label a declared tenant id")
+
+
 def _check_kernel_records(report) -> None:
     """WF109: compare every kernel-impl choice the registry recorded at
     trace time against what it would resolve to NOW (env/tuning-cache as of
@@ -1292,6 +1349,8 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _check_slo(report, getattr(p, "_monitoring_arg", None))
     _check_telemetry(report, getattr(p, "_monitoring_arg", None))
     _check_remediation(report, getattr(p, "_monitoring_arg", None), cfg)
+    _check_serving(report, getattr(p, "_serving_arg", None),
+                   getattr(p, "_monitoring_arg", None), supervised)
     _check_dispatch(report, dispatch, getattr(p, "_dispatch_arg", None), cfg,
                     trace, getattr(p, "_trace_arg", None), supervised)
 
@@ -1318,6 +1377,8 @@ def _validate_supervised(report, sp, faults, control, trace=None,
     _check_slo(report, getattr(sp, "_monitoring_arg", None))
     _check_telemetry(report, getattr(sp, "_monitoring_arg", None))
     _check_remediation_supervised(report, sp)
+    _check_serving(report, getattr(sp, "_serving_arg", None),
+                   getattr(sp, "_monitoring_arg", None), True)
     _check_dispatch(report, dispatch, getattr(sp, "_dispatch_arg", None),
                     cfg, trace, getattr(sp, "_trace_arg", None), True)
     _check_shards(report,
@@ -1374,6 +1435,8 @@ def _validate_threaded(report, tp, faults, control, supervised,
     _check_slo(report, getattr(tp, "_monitoring_arg", None))
     _check_telemetry(report, getattr(tp, "_monitoring_arg", None))
     _check_remediation(report, getattr(tp, "_monitoring_arg", None), cfg)
+    _check_serving(report, getattr(tp, "_serving_arg", None),
+                   getattr(tp, "_monitoring_arg", None), supervised)
     _check_dispatch(report, dispatch, getattr(tp, "_dispatch_arg", None),
                     cfg, trace, getattr(tp, "_trace_arg", None), supervised,
                     edges=edges)
@@ -1488,6 +1551,8 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_slo(report, getattr(g, "_monitoring_arg", None))
     _check_telemetry(report, getattr(g, "_monitoring_arg", None))
     _check_remediation(report, getattr(g, "_monitoring_arg", None), cfg)
+    _check_serving(report, getattr(g, "_serving_arg", None),
+                   getattr(g, "_monitoring_arg", None), supervised)
     dedges = None
     if threaded:
         try:
@@ -1520,6 +1585,34 @@ def _validate_compiled_chain(report, chain, faults, control,
         _check_trace(report, trace, None, supervised)
 
 
+def _validate_serving_runtime(report, rt, faults, control, trace=None,
+                              dispatch=None) -> None:
+    """A ServingRuntime is a Pipeline to the spec-flow checks, plus the
+    WF119 serving checks over its ALREADY-resolved config (construction
+    raised on fatal problems; the report re-derives them for tooling) and
+    a spec-flow pass over every registered swap-candidate graph — a swap
+    target that cannot type-check against the source would otherwise fail
+    mid-run, inside the cutover quiesce."""
+    cfg = _resolve_control(control, None)
+    in_spec = _source_spec(report, rt.source,
+                           f"source:{rt.source.getName()}")
+    if in_spec is None:
+        return
+    _validate_chain_ops(report, rt.chain.ops, in_spec, None, "serving",
+                        sink=rt.sink)
+    _check_stream_ops(report, rt.chain.ops, in_spec, "serving", [rt.source])
+    for label, g_ops in getattr(rt, "_graphs", {}).items():
+        _flow_ops(report, g_ops, in_spec, f"serving.graph[{label}]", None)
+    _check_faults(report, faults,
+                  "supervised" if rt._supervised else "pipeline")
+    _check_trace(report, trace, None, rt._supervised)
+    _check_health(report, rt._monitoring_arg)
+    _check_slo(report, rt._monitoring_arg)
+    _check_telemetry(report, rt._monitoring_arg)
+    _check_remediation(report, rt._monitoring_arg, cfg)
+    _check_serving(report, rt.config, rt._monitoring_arg, rt._supervised)
+
+
 # ------------------------------------------------------------------ public
 
 
@@ -1531,7 +1624,7 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     ``.raise_if_errors()`` to gate).
 
     ``obj``: a ``PipeGraph``, ``Pipeline``, ``ThreadedPipeline``,
-    ``SupervisedPipeline``, or raw ``CompiledChain``.
+    ``SupervisedPipeline``, ``ServingRuntime``, or raw ``CompiledChain``.
 
     ``faults``: a ``FaultPlan``/``FaultInjector``/JSON string to check
     against the sites the chosen driver actually threads; ``None`` consults
@@ -1564,8 +1657,13 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     from ..runtime.pipeline import CompiledChain, Pipeline
     from ..runtime.supervisor import SupervisedPipeline
     from ..runtime.threaded import ThreadedPipeline
+    from ..serving.runtime import ServingRuntime
 
-    if isinstance(obj, PipeGraph):
+    if isinstance(obj, ServingRuntime):
+        report = ValidationReport("ServingRuntime")
+        _validate_serving_runtime(report, obj, faults, control,
+                                  trace, dispatch)
+    elif isinstance(obj, PipeGraph):
         report = ValidationReport(f"PipeGraph({obj.name!r})")
         _validate_graph(report, obj, faults, control, bool(supervised),
                         threaded, trace, dispatch, shards, reshard,
@@ -1591,7 +1689,7 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
         report.add("WF100", "error", "target",
                    f"cannot validate a {type(obj).__name__}; expected "
                    f"PipeGraph, Pipeline, ThreadedPipeline, "
-                   f"SupervisedPipeline, or CompiledChain")
+                   f"SupervisedPipeline, ServingRuntime, or CompiledChain")
         return report
     _check_kernel_records(report)
     return report

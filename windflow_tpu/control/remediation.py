@@ -86,6 +86,11 @@ ACTUATORS = {
     "widen_delay":
         "recommend a wider watermark delay from the lateness histogram "
         "(advisory: WindowSpec.delay is a traced constant)",
+    "tenant_rate":
+        "scale ONE tenant's admission bucket by `factor` (clamped at "
+        "`floor`) — the serving plane resolves the firing SLO's tenant= "
+        "label to its bucket, so a noisy tenant is shed without touching "
+        "its neighbors' budgets (serving/runtime.py binds it)",
 }
 
 #: barrier-mode deterministic signal each actuator is evaluated on (None =

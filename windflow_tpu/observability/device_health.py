@@ -706,6 +706,39 @@ def merge_snapshots(snaps: Sequence[dict],
                 if pages:
                     dst["pages_by_host"][host] = pages
         out["slo"] = ssec
+    # serving sections: run-level counters SUMMED (frames_torn across the
+    # fleet is one total, like the telemetry fold), tenant rows joined by
+    # tenant id and SUMMED per id (one tenant's fleet-wide shed pressure is
+    # ONE series — the label is the tenant, not the host; the rate gauge
+    # takes MIN, the tightest remediated bucket across hosts), graph labels
+    # concatenated when hosts disagree mid-swap
+    serv_secs = [(h, s.get("serving")) for h, s in zip(hosts, snaps)
+                 if isinstance(s.get("serving"), dict)]
+    if serv_secs:
+        vsec: dict = {}
+        tenants: Dict[str, dict] = {}
+        graphs: List[str] = []
+        for host, sec in serv_secs:
+            g = sec.get("graph")
+            if g and g not in graphs:
+                graphs.append(g)
+            _sum_into(vsec, {k: v for k, v in sec.items()
+                             if isinstance(v, (int, float))
+                             and not isinstance(v, bool)})
+            for tid, row in (sec.get("tenants") or {}).items():
+                if not isinstance(row, dict):
+                    continue                  # torn/partial host section
+                dst = tenants.setdefault(str(tid), {})
+                rate = row.get("rate")
+                _sum_into(dst, {k: v for k, v in row.items()
+                                if k != "rate"})
+                if isinstance(rate, (int, float)):
+                    dst["rate"] = min(dst.get("rate", rate), rate)
+        if graphs:
+            vsec["graph"] = "+".join(graphs)
+        if tenants:
+            vsec["tenants"] = tenants
+        out["serving"] = vsec
     # health ledgers: devices concatenated (host-tagged), footprints and
     # compile counters summed, device-time summed with the dispatch-bound
     # classifier recomputed over the fleet totals

@@ -287,6 +287,50 @@ def _check_telemetry_names() -> None:
 
 _check_telemetry_names()
 
+#: HELP text per serving-plane gauge — checked against
+#: ``names.py::SERVING_GAUGES`` at import (the telemetry lockstep
+#: discipline).  Rendered as ``windflow_serving_<name>{graph}`` from the
+#: snapshot's ``serving`` section (``ServingRuntime.serving_section`` via
+#: ``attach_serving`` — absent when no serving runtime is attached, so the
+#: off path's artifacts are byte-identical).
+_SERVING_HELP = {
+    "swaps_applied": "zero-downtime graph_swap cutovers completed",
+    "swaps_rejected": "wire swap frames naming an unregistered graph",
+    "frames_decoded": "intact WFS1 record frames ingested",
+    "frames_torn": "ingest bytes resync'd past (torn client / garbage)",
+    "frames_dup": "reconnect-overlap frames deduped by tenant seq",
+    "clients_seen": "ingest connections accepted since serving start",
+    "unknown_offered": "batches from tenant ids nobody declared",
+}
+
+#: HELP text per tenant gauge — checked against ``names.py::TENANT_GAUGES``
+#: at import.  Rendered as ``windflow_tenant_<name>{graph,tenant="..."}``
+#: from the ``serving.tenants`` rows (the per-label SHARD_GAUGES shape).
+_TENANT_HELP = {
+    "offered": "batches this tenant offered to its admission bucket",
+    "admitted": "batches this tenant's controller admitted",
+    "shed": "batches this tenant's controller shed",
+    "shed_tuples": "tuple capacity this tenant's shed batches carried",
+    "rate": "the tenant bucket's live refill rate",
+}
+
+
+def _check_serving_names() -> None:
+    from .names import SERVING_GAUGES, TENANT_GAUGES
+    if set(_SERVING_HELP) != set(SERVING_GAUGES):
+        raise RuntimeError(
+            f"metrics.py serving exposition drifted from "
+            f"names.py::SERVING_GAUGES: "
+            f"{set(_SERVING_HELP) ^ set(SERVING_GAUGES)}")
+    if set(_TENANT_HELP) != set(TENANT_GAUGES):
+        raise RuntimeError(
+            f"metrics.py tenant exposition drifted from "
+            f"names.py::TENANT_GAUGES: "
+            f"{set(_TENANT_HELP) ^ set(TENANT_GAUGES)}")
+
+
+_check_serving_names()
+
 
 def _recovery_counters() -> Dict[str, float]:
     """Process-wide supervision counters (lazy import: runtime.faults imports
@@ -392,6 +436,14 @@ class MetricsRegistry:
         HOST-TAGGED (never summed) by ``device_health.merge_snapshots``,
         so the fleet view names WHICH shard is hot."""
         self._shards_provider = provider
+
+    def attach_serving(self, provider: Callable[[], dict]) -> None:
+        """Register a serving runtime's section provider
+        (``ServingRuntime.serving_section``: graph/swap/frame counters +
+        the per-tenant ``names.py::TENANT_GAUGES`` rows) — rendered as the
+        snapshot's ``serving`` section and folded counters-summed,
+        per-tenant-summed by ``device_health.merge_snapshots``."""
+        self._serving_provider = provider
 
     def attach_queue_gauge(self, edge: str, fn: Callable[[], int],
                            capacity: Optional[int] = None) -> None:
@@ -653,6 +705,14 @@ class MetricsRegistry:
             if rows:
                 # string keys: the section round-trips through JSON
                 snap["shards"] = {str(k): dict(v) for k, v in rows.items()}
+        serving_fn = getattr(self, "_serving_provider", None)
+        if serving_fn is not None:
+            try:
+                sec = serving_fn()
+            except Exception:       # noqa: BLE001 — never kill a snapshot
+                sec = None
+            if sec:
+                snap["serving"] = sec
         if self.event_time:
             et = self._event_time_section(et_secs)
             if et:
@@ -865,6 +925,42 @@ class MetricsRegistry:
                          f'{v}')
 
     @staticmethod
+    def _prometheus_serving(snap: dict, lines: List[str], esc) -> None:
+        """``windflow_serving_*`` run-level gauges + ``windflow_tenant_*``
+        per-tenant gauges from the snapshot's ``serving`` section.  Only
+        names registered in ``names.py::SERVING_GAUGES``/``TENANT_GAUGES``
+        render (the import-time lockstep check above)."""
+        sec = snap.get("serving")
+        if not sec:
+            return
+        g = snap["graph"]
+        for name in sorted(_SERVING_HELP):
+            v = sec.get(name)
+            if v is None:
+                continue
+            lines.append(f"# HELP windflow_serving_{name} "
+                         f"{_SERVING_HELP[name]}")
+            lines.append(f"# TYPE windflow_serving_{name} gauge")
+            lines.append(f'windflow_serving_{name}{{graph="{esc(g)}"}} {v}')
+        tenants = sec.get("tenants") or {}
+        typed = set()
+
+        def head(name):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# HELP windflow_tenant_{name} "
+                             f"{_TENANT_HELP[name]}")
+                lines.append(f"# TYPE windflow_tenant_{name} gauge")
+
+        for tid, row in sorted(tenants.items()):
+            lab = f'graph="{esc(g)}",tenant="{esc(tid)}"'
+            for name in sorted(_TENANT_HELP):
+                v = row.get(name)
+                if v is not None:
+                    head(name)
+                    lines.append(f'windflow_tenant_{name}{{{lab}}} {v}')
+
+    @staticmethod
     def _prometheus_event_time(snap: dict, lines: List[str], esc) -> None:
         """``windflow_event_time_*`` gauges (HELP/TYPE'd) from the snapshot's
         event-time sections: per-operator watermark/lag/occupancy/pressure,
@@ -982,6 +1078,7 @@ class MetricsRegistry:
         self._prometheus_health(snap, lines, esc)
         self._prometheus_slo(snap, lines, esc)
         self._prometheus_telemetry(snap, lines, esc)
+        self._prometheus_serving(snap, lines, esc)
         lines.append("# TYPE windflow_queue_depth gauge")
         for edge, depth in snap["queues"].items():
             lines.append(f'windflow_queue_depth{{graph="{esc(g)}",'

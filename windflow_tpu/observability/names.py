@@ -98,6 +98,13 @@ JOURNAL_EVENTS = (
     # actuator, gate, arbitration loss to auto-reshard); "tuning_reclimb" =
     # a converged autotuner was un-converged to re-explore its ladder
     "remediation_apply", "remediation_skip", "tuning_reclimb",
+    # serving front-end (serving/runtime.py ServingRuntime):
+    # "serving_start"/"serving_end" frame one service run (endpoint +
+    # tenant ids / batch + swap totals); "graph_swap" is BOTH the
+    # quiesce->warm->cutover span around a zero-downtime chain swap AND
+    # the point records inside it (applied=True with carried_state/
+    # warmed/quiesce_ms, or rejected=True for an unregistered wire swap)
+    "serving_start", "serving_end", "graph_swap",
 )
 
 #: flight-recorder record kinds (``observability/tracing.py``; the
@@ -295,6 +302,38 @@ FLEET_GAUGES = (
     "frames_received",  # telemetry frames decoded across all hosts
     "frames_torn",      # frames lost to torn/corrupt wire data (resync'd)
     "ticks",            # fleet merge ticks emitted
+)
+
+#: run-level gauges of the ``serving`` snapshot section
+#: (``serving/runtime.py`` ServingRuntime.serving_section ->
+#: ``MetricsRegistry.attach_serving``; ``metrics.py::_prometheus_serving``
+#: renders ONLY registered names as ``windflow_serving_<name>{graph=...}``
+#: — its local HELP map is checked against this tuple at import, the
+#: SLO_GAUGES lockstep discipline).  Counters summed, never host-tagged,
+#: by ``device_health.merge_snapshots`` (``swaps_applied`` across hosts is
+#: a fleet total like ``frames_torn``).
+SERVING_GAUGES = (
+    "swaps_applied",     # zero-downtime graph_swap cutovers completed
+    "swaps_rejected",    # wire swap frames naming an unregistered graph
+    "frames_decoded",    # intact WFS1 record frames ingested
+    "frames_torn",       # bytes resync'd past (torn client / garbage)
+    "frames_dup",        # reconnect-overlap frames deduped by tenant seq
+    "clients_seen",      # ingest connections accepted since start
+    "unknown_offered",   # batches from tenant ids nobody declared
+)
+
+#: per-TENANT gauges of the ``serving.tenants`` snapshot rows
+#: (``serving/tenants.py`` TenantRegistry.counters; rendered as
+#: ``windflow_tenant_<name>{graph,tenant=...}`` — the SHARD_GAUGES
+#: per-label discipline; folded SUMMED per tenant id across hosts by
+#: ``device_health.merge_snapshots``, so one tenant's fleet-wide shed
+#: pressure is one series)
+TENANT_GAUGES = (
+    "offered",           # batches this tenant offered to its bucket
+    "admitted",          # batches its controller admitted
+    "shed",              # batches its controller shed
+    "shed_tuples",       # tuple capacity those shed batches carried
+    "rate",              # the bucket's live refill rate (remediation moves it)
 )
 
 #: kernel families selectable through the per-backend kernel registry

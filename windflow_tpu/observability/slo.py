@@ -211,6 +211,51 @@ SIGNALS: Dict[str, Tuple[Callable, str]] = {
 }
 
 
+def _tenant_row(snap, tenant: str) -> Optional[dict]:
+    """One tenant's counter row from the serving section (``serving/
+    tenants.py`` TenantRegistry.counters -> snapshot ``serving.tenants``)."""
+    if snap is None:
+        return None
+    return ((snap.get("serving") or {}).get("tenants") or {}).get(tenant)
+
+
+def _sig_tenant_drop_ratio(snap, prev, tenant: str) -> Optional[float]:
+    """Per-tick shed fraction of ONE tenant's offered batches — the
+    isolation signal: a noisy tenant's shedding moves ONLY the SLOs
+    labelled with its id, a quiet neighbor's stays 0."""
+    row = _tenant_row(snap, tenant)
+    if row is None:
+        return None
+    prow = _tenant_row(prev, tenant) or {}
+    offered = float(row.get("offered", 0)) - float(prow.get("offered", 0))
+    if offered <= 0:
+        return None                      # no traffic from this tenant
+    shed = float(row.get("shed", 0)) - float(prow.get("shed", 0))
+    return max(shed, 0.0) / offered
+
+
+def _sig_tenant_shed_tuples(snap, prev, tenant: str) -> Optional[float]:
+    """Tuples one tenant lost to shedding this tick (absolute pressure —
+    the remediation gate's coordinate when ratios are too coarse)."""
+    row = _tenant_row(snap, tenant)
+    if row is None:
+        return None
+    prow = _tenant_row(prev, tenant) or {}
+    return max(float(row.get("shed_tuples", 0))
+               - float(prow.get("shed_tuples", 0)), 0.0)
+
+
+#: tenant-labelled signal family (the serving plane's label dimension):
+#: name -> (extractor(snap, prev, tenant), default mode).  A spec using one
+#: of these MUST carry ``tenant=`` (and a host signal must NOT) — enforced
+#: by spec_problems (WF116) and cross-checked against the declared tenant
+#: ids by the serving validator (WF119).
+TENANT_SIGNALS: Dict[str, Tuple[Callable, str]] = {
+    "tenant_drop_ratio": (_sig_tenant_drop_ratio, "max"),
+    "tenant_shed_tuples": (_sig_tenant_shed_tuples, "max"),
+}
+
+
 # -------------------------------------------------------------------- specs
 
 
@@ -239,11 +284,16 @@ class SLOSpec:
     page_burn: float = 2.0
     #: violation sense; None = the signal's default (SIGNALS)
     mode: Optional[str] = None
+    #: tenant label (serving plane): REQUIRED for TENANT_SIGNALS — the
+    #: extractor then reads this tenant's ``serving.tenants`` row only, so
+    #: one noisy tenant pages its own SLO without touching its neighbors'
+    #: budgets; must be None for host-level SIGNALS
+    tenant: Optional[str] = None
 
     def resolved_mode(self) -> str:
         if self.mode is not None:
             return self.mode
-        sig = SIGNALS.get(self.signal)
+        sig = SIGNALS.get(self.signal) or TENANT_SIGNALS.get(self.signal)
         return sig[1] if sig else "max"
 
     def violated(self, value: float) -> bool:
@@ -262,9 +312,19 @@ def spec_problems(spec: SLOSpec) -> List[str]:
     out = []
     if not spec.name or not str(spec.name).strip():
         out.append("spec has an empty name")
-    if spec.signal not in SIGNALS:
+    if spec.signal not in SIGNALS and spec.signal not in TENANT_SIGNALS:
         out.append(f"unknown signal {spec.signal!r} — registered signals: "
-                   f"{', '.join(sorted(SIGNALS))}")
+                   f"{', '.join(sorted(SIGNALS))}; tenant signals: "
+                   f"{', '.join(sorted(TENANT_SIGNALS))}")
+    if spec.signal in TENANT_SIGNALS and spec.tenant is None:
+        out.append(f"signal {spec.signal!r} is tenant-labelled but the spec "
+                   f"carries no tenant= — the extractor needs ONE tenant's "
+                   f"serving.tenants row to read")
+    if spec.signal in SIGNALS and spec.tenant is not None:
+        out.append(f"tenant={spec.tenant!r} on host-level signal "
+                   f"{spec.signal!r} — host signals carry no tenant "
+                   f"dimension (tenant signals: "
+                   f"{', '.join(sorted(TENANT_SIGNALS))})")
     if int(spec.fast_window) < 1:
         out.append(f"fast_window must be >= 1, got {spec.fast_window}")
     if int(spec.fast_window) >= int(spec.slow_window):
@@ -388,6 +448,10 @@ class _SLOState:
                "burn_fast": self.burn_fast, "burn_slow": self.burn_slow,
                "signal": self.last_value, "target": self.spec.target,
                "pages": self.pages}
+        if self.spec.tenant is not None:
+            # the serving label dimension: wf_top's tenants panel and the
+            # fleet fold join SLO state to tenant rows on this key
+            out["tenant"] = self.spec.tenant
         return out
 
 
@@ -470,8 +534,12 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
         self._incoming_slo = snap.get("slo")
         for st in self._states:
             spec = st.spec
-            extractor, _mode = SIGNALS[spec.signal]
-            value = extractor(snap, self._prev)
+            if spec.signal in TENANT_SIGNALS:
+                extractor, _mode = TENANT_SIGNALS[spec.signal]
+                value = extractor(snap, self._prev, spec.tenant)
+            else:
+                extractor, _mode = SIGNALS[spec.signal]
+                value = extractor(snap, self._prev)
             if value is not None:
                 st.last_value = round(float(value), 6)
                 st.window.append(spec.violated(value))
