@@ -113,6 +113,25 @@ def test_decoder_rejects_lying_nbytes_then_recovers():
     assert dec.frames_torn == 1
 
 
+def test_decoder_treats_non_numeric_meta_fields_as_torn():
+    """A well-formed frame whose meta carries a non-numeric nbytes/seq is a
+    TORN frame, never an exception out of feed() — one malicious frame must
+    not kill the client connection loop (the resync contract)."""
+    def raw(meta, records=b""):
+        payload = json.dumps(meta).encode() + b"\n" + records
+        return (framing_mod.MAGIC + b"%08x" % len(payload) + b"\n"
+                + payload + b"\n")
+    good = encode_record_frame(b"ok", tenant="b", seq=0)
+    wire = (raw({"kind": "data", "nbytes": None, "seq": 0}, b"xyz")
+            + raw({"kind": "data", "nbytes": "bogus", "seq": 0}, b"xyz")
+            + raw({"kind": "data", "nbytes": 3, "seq": [1]}, b"xyz")
+            + good)
+    dec = RecordFrameDecoder()
+    got = dec.feed(wire)                # must not raise
+    assert [m["tenant"] for m, _ in got] == ["b"]
+    assert dec.frames_torn == 3 and dec.frames_decoded == 1
+
+
 def test_parse_endpoint_grammar():
     pe = framing_mod.parse_endpoint
     assert pe("tcp://127.0.0.1:9500") == ("tcp", "127.0.0.1", 9500)
@@ -326,6 +345,35 @@ def test_noisy_tenant_sheds_under_its_own_bucket_only(tmp_path):
     assert rows["quiet"]["shed"] == 0 and rows["quiet"]["shed_tuples"] == 0
     quiet_vals = [v for _, v in got if v >= 2 * 10_000]
     assert len(quiet_vals) == sum(len(c) for c in quiet)
+
+
+def test_drop_oldest_ts_held_batches_are_not_counted_shed():
+    """shed_tuples follows the controller's own shed ledger: an empty
+    offer() return under drop_oldest_ts means HELD (admitted later by
+    drain), not shed — only a hold_max overflow sheds, and exactly that
+    batch's capacity is counted."""
+    class _B:
+        capacity = BATCH
+    reg = build_registry(
+        [{"id": "a", "refill_per_batch": 1.0, "burst": float(BATCH),
+          "shed_policy": "drop_oldest_ts"}], base_capacity=BATCH)
+    assert reg.offer("a", _B())         # burst affords the first batch
+    for _ in range(2):                  # held (hold_max=2), NOT shed
+        assert reg.offer("a", _B()) == []
+        assert reg.counters()["a"]["shed_tuples"] == 0
+    assert reg.offer("a", _B()) == []   # overflow: oldest held batch sheds
+    row = reg.counters()["a"]
+    assert row["shed"] == 1 and row["shed_tuples"] == BATCH
+    assert len(reg.drain()) == 2        # the held tail admits at EOS
+    row = reg.counters()["a"]
+    assert row["offered"] == 4 and row["admitted"] == 3
+    assert row["shed"] == 1 and row["shed_tuples"] == BATCH
+    # the totals ride the registry snapshot across a supervised restore
+    reg2 = build_registry(
+        [{"id": "a", "refill_per_batch": 1.0, "burst": float(BATCH),
+          "shed_policy": "drop_oldest_ts"}], base_capacity=BATCH)
+    reg2.set_state(reg.state())
+    assert reg2.counters()["a"]["shed_tuples"] == BATCH
 
 
 def test_registry_scale_rate_targets_one_tenant():

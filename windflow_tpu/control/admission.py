@@ -146,7 +146,15 @@ class AdmissionController:
         self.driver = driver
         self.held: deque = deque()           # wf-lint: guarded-by[_lock]
         self.admitted = 0                     # batches (per-controller, tests)
-        self.shed = 0
+        self.shed = 0                         # batches
+        #: tuple capacity the shed batches carried — the authoritative
+        #: per-controller shed accounting (an empty offer() return is NOT a
+        #: shed signal: drop_oldest_ts holds batches for a later drain).
+        #: In-memory only — deliberately NOT in state(): the supervised
+        #: checkpoint shape is pinned (test_remediation); callers that need
+        #: restore-spanning totals track per-offer deltas of this counter
+        #: (serving.tenants.TenantRegistry)
+        self.shed_tuples = 0
         #: pass one shared lock to controllers sharing one bucket (a graph
         #: with several sources rate-limits total ingest through one bucket
         #: but needs a *per-source* holding cell, so held batches always
@@ -161,6 +169,7 @@ class AdmissionController:
     def _shed(self, batch, pos, stream=None) -> None:
         cost = self._cost(batch)
         self.shed += 1
+        self.shed_tuples += cost
         _state.bump("shed_batches")
         _state.bump("shed_tuples", cost)
         extra = {} if stream is None else {"stream": stream}

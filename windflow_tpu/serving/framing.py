@@ -134,9 +134,19 @@ class RecordFrameDecoder:
                     meta = json.loads(payload[:nl])
                 except ValueError:
                     meta = None
-            if (not isinstance(meta, dict)
-                    or meta.get("kind") not in FRAME_KINDS
-                    or int(meta.get("nbytes", -1)) != len(payload) - nl - 1):
+            if not isinstance(meta, dict) or meta.get("kind") not in FRAME_KINDS:
+                self.frames_torn += 1
+                continue
+            # meta is attacker-supplied JSON: a null/non-numeric nbytes or
+            # seq is a torn frame, never an exception out of feed() — one
+            # malformed frame must not kill the client connection loop
+            try:
+                nbytes = int(meta.get("nbytes", -1))
+                meta["seq"] = int(meta.get("seq", 0))
+            except (ValueError, TypeError):
+                self.frames_torn += 1
+                continue
+            if nbytes != len(payload) - nl - 1:
                 self.frames_torn += 1
                 continue
             meta.setdefault("tenant", DEFAULT_TENANT)

@@ -111,7 +111,12 @@ class ServingRuntime:
             self.config.resolved_tenants(), self._cap,
             supervised=supervised)
         self._monitor = None
-        self._running = False
+        self._running = False                 # wf-lint: guarded-by[_run_lock]
+        #: closes the swap_graph/run() TOCTOU: _running flips only under
+        #: this lock, and swap_graph's not-running immediate apply holds it
+        #: too — an apply on the caller thread can never overlap a drive
+        #: loop that is just starting (or just ending)
+        self._run_lock = threading.Lock()
 
     # -- graph management -----------------------------------------------
 
@@ -137,8 +142,13 @@ class ServingRuntime:
             ops = list(graph)
         self._swap_queue.append((label or f"swap{self.swaps_applied + 1}",
                                  ops))
-        if not self._running:
-            self._consume_swaps()
+        with self._run_lock:
+            # under _run_lock either we see _running=True (the drive thread
+            # consumes the queued request at its next batch boundary) or the
+            # immediate apply completes before run() can flip _running and
+            # start pushing batches
+            if not self._running:
+                self._consume_swaps()
 
     def _consume_swaps(self) -> None:
         """Batch-boundary swap point: drain API-queued requests plus any
@@ -255,7 +265,8 @@ class ServingRuntime:
             endpoint=getattr(self.source, "endpoint", None),
             tenants=(self.registry.ids if self.registry is not None
                      else [DEFAULT_TENANT]))
-        self._running = True
+        with self._run_lock:
+            self._running = True
         try:
             n = 0
             n_offered = 0
@@ -312,7 +323,8 @@ class ServingRuntime:
                 op.close()
             return self.chain.result()
         finally:
-            self._running = False
+            with self._run_lock:
+                self._running = False
             if mon is not None:
                 mon.finish(self)
 

@@ -88,11 +88,12 @@ class SocketSource(RecordSource):
         # clean OSError exit
         self._server: Optional[socket.socket] = None  # wf-lint: single-writer[driver]
         self._threads = []                       # wf-lint: guarded-by[_lock]
-        #: wire-level counters (snapshot ``serving`` section)
-        self.frames_decoded = 0
-        self.frames_torn = 0
-        self.frames_dup = 0
-        self.clients_seen = 0
+        #: wire-level counters (snapshot ``serving`` section); updated by
+        #: every client thread, so increments happen under ``_lock``
+        self.frames_decoded = 0                  # wf-lint: guarded-by[_lock]
+        self.frames_torn = 0                     # wf-lint: guarded-by[_lock]
+        self.frames_dup = 0                      # wf-lint: guarded-by[_lock]
+        self.clients_seen = 0                    # wf-lint: single-writer[ingest]
         #: tenant of the chunk most recently handed to the drive loop —
         #: valid only under the single-threaded, un-prefetched drive
         #: contract (module docstring)
@@ -181,8 +182,11 @@ class SocketSource(RecordSource):
                 for meta, blob in dec.feed(data):
                     self._on_frame(meta, blob)
                 # decoder counters are cumulative; publish deltas and reset
-                self.frames_decoded += dec.frames_decoded
-                self.frames_torn += dec.frames_torn
+                # (under _lock — concurrent clients read-modify-write the
+                # same shared counters)
+                with self._lock:
+                    self.frames_decoded += dec.frames_decoded
+                    self.frames_torn += dec.frames_torn
                 dec.frames_decoded = 0
                 dec.frames_torn = 0
         finally:
@@ -219,7 +223,12 @@ class SocketSource(RecordSource):
             idx = self._next_chunk
             self._next_chunk += 1
             self._ring.append((idx, tenant, rec))
-        self._queue.put((idx, tenant, rec))
+            # the put MUST stay inside the lock: with concurrent clients,
+            # enqueueing outside would let a later idx land first and the
+            # in-order consumer (_chunks_from_ring) would silently drop the
+            # overtaken chunk; in-lock it also cannot land after an EOS
+            # whose empty-queue check already passed
+            self._queue.put((idx, tenant, rec))
 
     def pop_swap_request(self) -> Optional[str]:
         """Next pending wire swap request (ServingRuntime polls at batch

@@ -164,6 +164,10 @@ class TenantRegistry:
         # single-writer: the serving drive loop is one thread; the Reporter
         # only reads the ints (torn reads are fine for gauges)
         self._offered: Dict[str, int] = {s.id: 0 for s in specs}
+        #: restore-spanning shed tuple totals, advanced by per-offer deltas
+        #: of the controller's own shed ledger (ctl.shed_tuples) — NEVER by
+        #: inferring shed from an empty offer() return, which conflates
+        #: shed with held under drop_oldest_ts
         self._shed_tuples: Dict[str, int] = {s.id: 0 for s in specs}
         self.unknown_offered = 0
 
@@ -179,9 +183,13 @@ class TenantRegistry:
         self._offered[tenant] += 1
         if ctl is None:                         # declared, rate-unlimited
             return [batch]
+        before = ctl.shed_tuples
         admitted = ctl.offer(batch, pos=pos, stream=tenant)
-        if not admitted:
-            self._shed_tuples[tenant] += int(batch.capacity)
+        # the controller's shed ledger is the only truth: an empty return
+        # does NOT mean shed (drop_oldest_ts holds the batch for a later
+        # offer()/drain() to admit), and a non-empty return may have shed
+        # an older held batch
+        self._shed_tuples[tenant] += ctl.shed_tuples - before
         return admitted
 
     def drain(self) -> list:
@@ -224,6 +232,9 @@ class TenantRegistry:
     # -- supervised snapshot/restore -----------------------------------
 
     def state(self) -> dict:
+        # shed_tuples rides the registry (not the controller snapshot,
+        # whose shape is pinned) — restored totals keep accumulating via
+        # the per-offer delta discipline in offer()
         return {
             "tenants": {tid: ctl.state()
                         for tid, ctl in self._controllers.items()
