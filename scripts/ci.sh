@@ -3,6 +3,7 @@
 #
 #   wf_lint (framework-invariant linter + WF26x concurrency pass, exit 0/1/2)
 #     -> wf_perfgate (hermetic AOT cost pins + proxy microbenches, 0/1/2)
+#     -> wf_progcheck (device-program analyzer, WF3xx jaxpr audit, 0/1/2)
 #     -> tier-1 tests (the ROADMAP.md verify command)
 #
 # Every step runs even when an earlier one failed (the full picture in one
@@ -34,6 +35,10 @@ run_step() {
     if [ "$name" = "wf_lint" ]; then
         # the one-line verdict ("wf_lint: N finding(s) (M baselined)")
         note=$(grep -a '^wf_lint:' "$out" | tail -1 | sed 's/^wf_lint: //')
+    elif [ "$name" = "wf_progcheck" ]; then
+        # "wf_progcheck: N finding(s) (M baselined, P programs)"
+        note=$(grep -a '^wf_progcheck:' "$out" | tail -1 \
+               | sed 's/^wf_progcheck: //')
     fi
     rm -f "$out"
     step_names+=("$name"); step_rcs+=("$rc")
@@ -48,6 +53,10 @@ run_step() {
 
 run_step "wf_lint" python scripts/wf_lint.py
 run_step "perf gate" env JAX_PLATFORMS=cpu python scripts/wf_perfgate.py
+# the device-program analyzer: jaxpr-level WF3xx audit over the registered
+# target families (nexmark, ysb, mp-matrix, examples) — exits 1 on fresh
+# findings OR baseline entries missing a written rationale
+run_step "wf_progcheck" env JAX_PLATFORMS=cpu python scripts/wf_progcheck.py
 
 # stdlib-CLI exit-code contracts under a poisoned-jax PYTHONPATH: every
 # artifact CLI must run on a box without JAX (they load the observability
@@ -236,11 +245,30 @@ assert [e["event"] for e in rem["events"]] == \
              "want 2)" >&2
         rm -rf "$tmp"; return 1
     fi
+    # wf_progcheck is the ONE jax-needing CLI: on a box without jax it must
+    # exit 2 with a one-line verdict (never a traceback), and its --explain
+    # path (docstring-only, loaded by file path) must still work
+    PYTHONPATH="$tmp" python scripts/wf_progcheck.py >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 2 ]; then
+        echo "ci: wf_progcheck.py no-jax contract broke (rc=${rc}," \
+             "want 2)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_progcheck.py --explain WF300 \
+        >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_progcheck.py --explain without jax broke (rc=${rc}," \
+             "want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
     rm -rf "$tmp"
     echo "stdlib CLI exit contracts ok (wf_slo 0/1/2 + remediation ledger,"
     echo "wf_state/wf_health/wf_trace/wf_fleet/wf_top/wf_serve 2 on missing"
     echo "inputs, fleet + serving loopback selftests, wf_top/wf_slo over"
-    echo "the aggregator dir; all without jax)"
+    echo "the aggregator dir; all without jax. wf_progcheck: 2 without jax,"
+    echo "--explain still answers)"
 }
 run_step "stdlib CLIs" stdlib_cli_contracts
 

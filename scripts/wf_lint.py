@@ -82,10 +82,15 @@ def _explain(lint, code: str) -> int:
     severity, summary = lint.RULES[code]
     print(f"{code} [{severity}] {summary}")
     # the long-form story lives in the implementing module's docstring —
-    # print the matching table row block for context
-    doc_mod = (lint.concurrency_module() if code.startswith("WF26")
-               else lint)
-    doc = doc_mod.__doc__ or ""
+    # print the matching table row block for context.  WF26x lives in
+    # concurrency.py; WF30x in progcheck.py (read via ast — progcheck
+    # imports JAX, and --explain must work on a box without it)
+    if code.startswith("WF26"):
+        doc = lint.concurrency_module().__doc__ or ""
+    elif code.startswith("WF30"):
+        doc = lint.progcheck_doc()
+    else:
+        doc = lint.__doc__ or ""
     in_block = False
     for line in doc.splitlines():
         if line.strip().startswith(code):

@@ -21,7 +21,6 @@ from windflow_tpu.nexmark import make_query
 from windflow_tpu.observability import (MonitoringConfig, device_health as
                                         dh, fleet, metrics as metrics_mod,
                                         names, slo as slomod)
-from windflow_tpu.runtime.pipeline import CompiledChain
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HOST_DRIVER = os.path.join(REPO, "tests", "fleet_host_driver.py")
@@ -452,21 +451,9 @@ def test_telemetry_on_results_byte_identical(tmp_path, driver):
     assert "telemetry" in snap
 
 
-def test_off_path_hlo_identical(monkeypatch):
-    """WF_TELEMETRY contributes no equations: the lowered program is
-    textually identical with the env set vs not — the perf-gate pins
-    cannot move."""
-    def lowered_text():
-        src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=512,
-                        num_keys=4)
-        chain = CompiledChain([wf.Map(lambda t: {"v": t.v * 2})],
-                              src.payload_spec(), batch_capacity=64)
-        b = next(iter(src.batches(64)))
-        return chain._step_fn(0).lower(tuple(chain.states), b).as_text()
-    base = lowered_text()
-    monkeypatch.setenv("WF_MONITORING", "1")
-    monkeypatch.setenv("WF_TELEMETRY", "tcp://127.0.0.1:9")
-    assert lowered_text() == base
+# WF_TELEMETRY's program-identity pin (formerly an ad-hoc HLO-text
+# comparison here) lives in the shared toggle-OFF fingerprint gate:
+# tests/test_program_fingerprint.py, TOGGLES["telemetry"].
 
 
 # ------------------------------------------------------------ WF117 pins

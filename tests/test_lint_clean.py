@@ -474,3 +474,64 @@ def test_cli_update_baseline_roundtrip(tmp_path):
     assert isinstance(data["findings"], list)
     proc2 = _run_cli(env={"WF_LINT_BASELINE": str(bpath)})
     assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+
+
+# ------------------------------------- WF30x registration (progcheck codes)
+# The device-program analyzer (analysis/progcheck.py) emits WF300-WF305 at
+# TRACE time, not parse time — but the codes live in the one shared RULES
+# table so --select/--ignore/--explain speak a single grammar across
+# wf_lint and wf_progcheck.
+
+
+def test_wf30x_registered_in_rules():
+    """All six progcheck codes are registered, with the severity split the
+    analyzer documents: replay-visible determinism breaks and buffer
+    aliasing are errors; advisory rankings are warnings."""
+    for code in ("WF300", "WF301", "WF302", "WF303", "WF304", "WF305"):
+        assert code in lint.RULES, code
+        severity, summary = lint.RULES[code]
+        assert severity in ("error", "warning") and summary
+    assert lint.RULES["WF300"][0] == "error"
+    assert lint.RULES["WF301"][0] == "error"
+    assert lint.RULES["WF304"][0] == "error"
+    assert lint.RULES["WF302"][0] == "warning"
+    assert lint.RULES["WF303"][0] == "warning"
+    assert lint.RULES["WF305"][0] == "warning"
+
+
+def test_progcheck_doc_covers_every_wf30x_code():
+    """--explain's long-form block comes from progcheck.py's docstring,
+    read via ast WITHOUT importing it (progcheck imports JAX; lint.py must
+    stay loadable-by-path on a jax-less box).  Every registered WF30x code
+    must have a row there or --explain prints an empty block."""
+    doc = lint.progcheck_doc()
+    for code in [c for c in lint.RULES if c.startswith("WF30")]:
+        assert code in doc, f"{code} missing from progcheck.py docstring"
+
+
+def test_cli_explain_wf30x_without_jax(tmp_path):
+    """wf_lint --explain WF30x works on a box where importing jax is
+    poisoned — the docstring is read textually, never imported."""
+    d = tmp_path / "nojax"
+    d.mkdir()
+    (d / "jax.py").write_text("raise ImportError('explain must not import "
+                              "jax')\n")
+    for code in ("WF300", "WF305"):
+        proc = _run_cli("--explain", code, env={"PYTHONPATH": str(d)})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert code in proc.stdout
+    # and the block carries the rule's story, not just the RULES row
+    proc = _run_cli("--explain", "WF302", env={"PYTHONPATH": str(d)})
+    assert "dispatch_ratio" in proc.stdout
+
+
+def test_cli_family_token_wf30x():
+    """The family grammar extends to WF3xx: WF30x resolves through RULES
+    (the lint passes never emit those codes, so the select runs clean);
+    an unregistered family like WF39x stays a broken invocation (exit 2),
+    never a silent no-op."""
+    proc = _run_cli("--select", "WF30x", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_cli("--select", "WF39x")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unknown rule family" in proc.stderr

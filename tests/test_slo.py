@@ -20,7 +20,6 @@ from windflow_tpu.observability import (MonitoringConfig, set_journal,
                                         slo_engine as slo)
 from windflow_tpu.runtime.faults import (FaultPlan, FaultSpec,
                                          reset_counters)
-from windflow_tpu.runtime.pipeline import CompiledChain
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WF_SLO_CLI = os.path.join(REPO, "scripts", "wf_slo.py")
@@ -470,20 +469,9 @@ def test_slo_on_results_byte_identical(tmp_path, driver):
     assert on == base
 
 
-def test_off_path_hlo_identical(monkeypatch):
-    """WF_SLO contributes no equations: the lowered program is textually
-    identical with the env set vs not — the perf-gate pins cannot move."""
-    def lowered_text():
-        src = wf.Source(lambda i: {"v": i.astype(jnp.float32)}, total=512,
-                        num_keys=4)
-        chain = CompiledChain([wf.Map(lambda t: {"v": t.v * 2})],
-                              src.payload_spec(), batch_capacity=64)
-        b = next(iter(src.batches(64)))
-        return chain._step_fn(0).lower(tuple(chain.states), b).as_text()
-    base = lowered_text()
-    monkeypatch.setenv("WF_SLO", "1")
-    monkeypatch.setenv("WF_MONITORING", "1")
-    assert lowered_text() == base
+# WF_SLO's program-identity pin (formerly an ad-hoc HLO-text comparison
+# here) lives in the shared toggle-OFF fingerprint gate:
+# tests/test_program_fingerprint.py, TOGGLES["slo"].
 
 
 # ------------------------------------------------- windowed e2e latency

@@ -173,6 +173,14 @@ DEFAULT_REPLAY_MODULES = (
     "windflow_tpu/state/host_store.py",
     "windflow_tpu/ops/lookup.py",
     "windflow_tpu/operators/join.py",
+    # the serving plane (PR 18) and fleet aggregation (PR 16) postdate
+    # this list: their callbacks/admission decisions ride the same
+    # deterministic-replay path as the supervised drivers they feed
+    "windflow_tpu/serving/framing.py",
+    "windflow_tpu/serving/sources.py",
+    "windflow_tpu/serving/tenants.py",
+    "windflow_tpu/serving/runtime.py",
+    "windflow_tpu/observability/fleet.py",
 )
 
 

@@ -168,6 +168,33 @@ RULES: Dict[str, Tuple[str, str]] = {
                          "join() on the shutdown path"),
     "WF265": ("error", "wf-lint concurrency annotation grammar error "
                        "(unknown role / empty role list)"),
+    # -- the WF30x device-program family (analysis/progcheck.py) ----------
+    # progcheck-time codes: emitted by the jaxpr analyzer (which needs
+    # JAX), registered here so --explain/--select know them — this linter
+    # never emits them (the WF116-119 precedent).  --explain reads the
+    # analyzer's docstring via progcheck_doc() WITHOUT importing it.
+    "WF300": ("error", "order-dependent float accumulation (scatter-add "
+                       "with possibly-duplicate indices on a float dtype) "
+                       "in a deterministic-replay program"),
+    "WF301": ("error", "unordered host effect (io_callback/debug_callback "
+                       "without ordered=True) reachable from a compiled "
+                       "step/scan body — the jaxpr-level complement of "
+                       "WF262"),
+    "WF302": ("warning", "host-sync in the per-push hot path: a callback "
+                         "primitive forcing a blocking D2H round trip "
+                         "outside the maintain/settle surfaces (a fusion "
+                         "candidate next to wf_health's dispatch_ratio)"),
+    "WF303": ("warning", "retrace-signature hazard from actual avals: "
+                         "weak-typed program inputs/consts or Python-"
+                         "scalar promotions that retrace per call value "
+                         "(subsumes the WF102 heuristic)"),
+    "WF304": ("error", "donated-buffer aliasing: a donated input read "
+                       "after the equation XLA aliases it into, or "
+                       "aliased into two outputs"),
+    "WF305": ("warning", "shard/K-variant float reduction: accumulation "
+                         "grouping that can change with shard count or "
+                         "dispatch K (the static evidence for retiring "
+                         "WF115 pairings)"),
 }
 
 
@@ -217,6 +244,15 @@ class LintConfig:
         # replay exactly (the spill/readmit protocol is position-driven)
         os.path.join("windflow_tpu", "state", "tiered.py"),
         os.path.join("windflow_tpu", "state", "host_store.py"),
+        # the serving plane: admission, framing and replay decisions feed
+        # the supervised drivers, so they must replay position-driven
+        os.path.join("windflow_tpu", "serving", "framing.py"),
+        os.path.join("windflow_tpu", "serving", "sources.py"),
+        os.path.join("windflow_tpu", "serving", "tenants.py"),
+        os.path.join("windflow_tpu", "serving", "runtime.py"),
+        # fleet aggregation windows feed SLO verdicts that remediation
+        # acts on — wall-clock reads need an argued allow[wall-clock]
+        os.path.join("windflow_tpu", "observability", "fleet.py"),
     )
     #: the central name registries (parsed with ast, never imported)
     names_file: str = os.path.join("windflow_tpu", "observability", "names.py")
@@ -831,6 +867,18 @@ def concurrency_module():
         spec.loader.exec_module(mod)
         _CONCURRENCY_MOD = mod
     return _CONCURRENCY_MOD
+
+
+def progcheck_doc() -> str:
+    """The docstring of the sibling ``progcheck.py`` — parsed with ast,
+    NEVER imported (progcheck genuinely needs JAX; this linter and the
+    ``wf_lint --explain WF30x`` path must keep working on a box without
+    it)."""
+    import ast
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "progcheck.py")
+    with open(path, encoding="utf-8") as f:
+        return ast.get_docstring(ast.parse(f.read())) or ""
 
 
 def rule_concurrency(cfg: LintConfig) -> List[Finding]:

@@ -162,6 +162,7 @@ Usage::
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, List, Optional
 
 import jax
@@ -1585,6 +1586,54 @@ def _validate_compiled_chain(report, chain, faults, control,
         _check_trace(report, trace, None, supervised)
 
 
+def _check_progcheck(report, obj, progcheck, dispatch, supervised,
+                     shards) -> None:
+    """WF300-WF305: trace the driver's built-but-not-run step/scan
+    programs (``analysis/progcheck.py`` — zero FLOPs, zero device) and
+    append the device-program findings, baseline-suppressed like the CLI.
+
+    Gated by the ``progcheck=`` kwarg, else ``WF_PROGCHECK`` (default on,
+    ``'0'`` disables).  Skipped when the report already carries errors
+    (tracing a graph whose specs do not even flow would only bury the real
+    diagnosis under a TypeError), and NEVER fatal: a trace failure means
+    the dynamic path will surface it with full context."""
+    if progcheck is None:
+        progcheck = os.environ.get("WF_PROGCHECK", "1") not in ("", "0")
+    if not progcheck or not report.ok:
+        return
+    try:
+        from . import progcheck as pc
+        from ..runtime.dispatch import DispatchConfig
+        chains = []
+        if getattr(obj, "chain", None) is not None:
+            chains.append(("chain", obj.chain))
+        elif getattr(obj, "chains", None):
+            chains += [(f"seg{i}", c) for i, c in enumerate(obj.chains)]
+        elif getattr(obj, "ops", None) is not None \
+                and getattr(obj, "specs", None) is not None:
+            chains.append(("chain", obj))        # a raw CompiledChain
+        if not chains:
+            return
+        dcfg = DispatchConfig.resolve(
+            dispatch if dispatch is not None
+            else getattr(obj, "_dispatch_arg", None))
+        k = dcfg.k if dcfg is not None else 1
+        from ..parallel.sharding import resolve_shards
+        n_shards = resolve_shards(shards if shards is not None
+                                  else getattr(obj, "_shards", None)) or 1
+        programs = []
+        for label, chain in chains:
+            programs += pc.chain_programs(
+                chain, k=k, shards=n_shards,
+                replay=bool(supervised), target=label)
+        findings = pc.analyze_programs(programs)
+        counts, _problems = pc.load_baseline(pc.baseline_path())
+        for f in pc.apply_baseline(findings, counts):
+            report.add(f.code, f.severity, f.path, f.message)
+    except Exception:  # noqa: BLE001 — analysis must never block validation
+        return
+
+
 def _validate_serving_runtime(report, rt, faults, control, trace=None,
                               dispatch=None) -> None:
     """A ServingRuntime is a Pipeline to the spec-flow checks, plus the
@@ -1618,7 +1667,8 @@ def _validate_serving_runtime(report, rt, faults, control, trace=None,
 
 def validate(obj, *, faults=None, control=None, supervised: bool = None,
              threaded: bool = False, trace=None, dispatch=None,
-             shards=None, reshard=None, shard_key=None) -> ValidationReport:
+             shards=None, reshard=None, shard_key=None,
+             progcheck: bool = None) -> ValidationReport:
     """Validate a built-but-not-run driver object; returns a
     :class:`ValidationReport` (never raises on findings — call
     ``.raise_if_errors()`` to gate).
@@ -1652,7 +1702,13 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
     for the WF115 checks — a ``SupervisedPipeline`` consults its own
     stored arguments when these are None; for a ``PipeGraph`` pass the
     values you will pass to ``run_supervised`` (with ``supervised=True``;
-    ``shards=None`` consults ``WF_SHARDS``, mirroring the driver)."""
+    ``shards=None`` consults ``WF_SHARDS``, mirroring the driver).
+
+    ``progcheck``: run the device-program analyzer (WF300-WF305,
+    ``analysis/progcheck.py``) over the object's built-but-not-run
+    step/scan programs under the resolved dispatch K / shard / supervision
+    config; ``None`` consults ``WF_PROGCHECK`` (default on, ``'0'``
+    disables). Skipped when the report already has errors."""
     from ..runtime.pipegraph import PipeGraph
     from ..runtime.pipeline import CompiledChain, Pipeline
     from ..runtime.supervisor import SupervisedPipeline
@@ -1692,4 +1748,8 @@ def validate(obj, *, faults=None, control=None, supervised: bool = None,
                    f"SupervisedPipeline, ServingRuntime, or CompiledChain")
         return report
     _check_kernel_records(report)
+    _check_progcheck(report, obj, progcheck, dispatch,
+                     supervised if supervised is not None
+                     else isinstance(obj, SupervisedPipeline),
+                     shards)
     return report

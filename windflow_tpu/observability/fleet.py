@@ -534,7 +534,7 @@ class FleetAggregator:
         self._ticks = 0
         self._jsonl_lines = 0
         self._first_fresh_t: Optional[float] = None
-        self._started = time.monotonic()
+        self._started = time.monotonic()  # wf-lint: allow[wall-clock] timing-only: uptime display
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
@@ -668,7 +668,7 @@ class FleetAggregator:
                                          "last_rx": 0.0}
                 joined = True
             h["connected"] = True
-            h["last_rx"] = time.monotonic()
+            h["last_rx"] = time.monotonic()  # wf-lint: allow[wall-clock] timing-only: staleness display
             seq = frame.get("seq")
             if isinstance(seq, int):
                 h["seq"] = seq               # informational (restart shows
@@ -676,7 +676,7 @@ class FleetAggregator:
                 h["snap"] = frame["snap"]
                 h["fresh"] = True
                 if self._first_fresh_t is None:
-                    self._first_fresh_t = time.monotonic()
+                    self._first_fresh_t = time.monotonic()  # wf-lint: allow[wall-clock] timing-only: skew-gate cadence
             h["mon_dir"] = frame.get("mon_dir") or h["mon_dir"]
             if frame.get("incidents"):
                 h["incidents"] = frame["incidents"]
@@ -708,7 +708,7 @@ class FleetAggregator:
             with self._lock:
                 t0 = self._first_fresh_t
                 if (t0 is not None
-                        and time.monotonic() - t0 >= self.max_skew_s):
+                        and time.monotonic() - t0 >= self.max_skew_s):  # wf-lint: allow[wall-clock] timing-only: emit cadence
                     self._emit_locked()
 
     # -- fleet tick --------------------------------------------------------
@@ -742,8 +742,8 @@ class FleetAggregator:
             if h is not None:
                 row["mon_dir"] = h["mon_dir"]
                 row["connected"] = bool(h["connected"])
-        merged["wall_time"] = time.time()
-        merged["uptime_s"] = round(time.monotonic() - self._started, 3)
+        merged["wall_time"] = time.time()  # wf-lint: allow[wall-clock] timing-only: report stamp
+        merged["uptime_s"] = round(time.monotonic() - self._started, 3)  # wf-lint: allow[wall-clock] timing-only: uptime display
         self._ticks += 1
         merged["fleet"] = {
             "hosts_connected": sum(1 for h in self._hosts.values()  # wf-lint: allow[unguarded]

@@ -170,7 +170,7 @@ class ServingRuntime:
             self._apply_swap(label, ops)
 
     def _apply_swap(self, label: str, ops) -> None:
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # wf-lint: allow[wall-clock] timing-only: swap metric
         with _journal.span("graph_swap", graph=str(label),
                            from_graph=self.graph_label):
             old = self.chain
@@ -197,7 +197,7 @@ class ServingRuntime:
             _journal.record(
                 "graph_swap", graph=str(label), applied=True,
                 carried_state=carried, warmed=bool(self.config.swap_warm),
-                quiesce_ms=round((time.perf_counter() - t0) * 1e3, 3))
+                quiesce_ms=round((time.perf_counter() - t0) * 1e3, 3))  # wf-lint: allow[wall-clock] timing-only: swap metric
 
     # -- observability surface ------------------------------------------
 
@@ -275,7 +275,7 @@ class ServingRuntime:
                 nonlocal n
                 sampled = (mon is not None and self.sink is not None
                            and mon.config.should_sample_e2e(n))
-                t0 = time.perf_counter() if sampled else 0.0
+                t0 = time.perf_counter() if sampled else 0.0  # wf-lint: allow[wall-clock] timing-only: e2e sample
                 span = _tracing.service(b, "chain")
                 out = self.chain.push(b)
                 if span is not None:
@@ -287,7 +287,7 @@ class ServingRuntime:
                     if sspan is not None:
                         sspan.done()
                 if sampled:
-                    mon.registry.record_e2e(time.perf_counter() - t0,
+                    mon.registry.record_e2e(time.perf_counter() - t0,  # wf-lint: allow[wall-clock] timing-only: e2e sample
                                             exemplar=_tracing.tid_of(b))
                 n += 1
 
