@@ -245,6 +245,60 @@ assert [e["event"] for e in rem["events"]] == \
              "want 2)" >&2
         rm -rf "$tmp"; return 1
     fi
+    # profile-on-page CLI contracts: summary mode is stdlib (missing inputs
+    # -> 2), and --capture NEEDS jax so the poisoned box must get the
+    # one-line exit-2 verdict, never a traceback and never a fake capture
+    PYTHONPATH="$tmp" python scripts/wf_profile.py \
+        --monitoring-dir "$tmp/nope" >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 2 ]; then
+        echo "ci: wf_profile.py missing-inputs contract broke (rc=${rc}," \
+             "want 2)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    PYTHONPATH="$tmp" python scripts/wf_profile.py \
+        --capture "$tmp/prof" >/dev/null 2>&1
+    rc=$?
+    if [ "$rc" -ne 2 ]; then
+        echo "ci: wf_profile.py poisoned-jax --capture contract broke" \
+             "(rc=${rc}, want 2)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    # per-tenant wire-to-sink report pin: a synthetic flight recorder whose
+    # ingest record carries the serving extras (tenant/seq/wire_ms/queue_ms)
+    # must render the tenant section with the right slowest-segment verdict
+    python - "$tmp" <<'PY'
+import json, os, sys
+d = os.path.join(sys.argv[1], "wiretrace"); os.makedirs(d, exist_ok=True)
+with open(os.path.join(d, "meta.json"), "w") as f:
+    json.dump({"run_id": "ci-wire", "capacity": 64, "dropped": 0}, f)
+recs = [
+    {"tid": 1, "stage": "source", "kind": "ingest", "t": 0.300, "pos": 0,
+     "tenant": "noisy", "seq": 3, "wire_ms": 250.0, "queue_ms": 2.0},
+    {"tid": 1, "stage": "chain", "kind": "begin", "t": 0.301},
+    {"tid": 1, "stage": "chain", "kind": "end", "t": 0.304},
+]
+with open(os.path.join(d, "flight.jsonl"), "w") as f:
+    for r in recs:
+        f.write(json.dumps(r) + "\n")
+PY
+    local wireout
+    wireout=$(PYTHONPATH="$tmp" python scripts/wf_trace.py \
+        --trace-dir "$tmp/wiretrace" --report 2>&1)
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "ci: wf_trace.py per-tenant report exit contract broke" \
+             "(rc=${rc}, want 0)" >&2
+        rm -rf "$tmp"; return 1
+    fi
+    if ! printf '%s' "$wireout" \
+            | grep -q "per-tenant wire-to-sink attribution" \
+        || ! printf '%s' "$wireout" | grep -q "tenant 'noisy'" \
+        || ! printf '%s' "$wireout" | grep -q "slowest segment: wire"; then
+        echo "ci: wf_trace.py --report did not render the per-tenant" \
+             "wire-to-sink section" >&2
+        rm -rf "$tmp"; return 1
+    fi
     # wf_progcheck is the ONE jax-needing CLI: on a box without jax it must
     # exit 2 with a one-line verdict (never a traceback), and its --explain
     # path (docstring-only, loaded by file path) must still work
@@ -265,10 +319,11 @@ assert [e["event"] for e in rem["events"]] == \
     fi
     rm -rf "$tmp"
     echo "stdlib CLI exit contracts ok (wf_slo 0/1/2 + remediation ledger,"
-    echo "wf_state/wf_health/wf_trace/wf_fleet/wf_top/wf_serve 2 on missing"
-    echo "inputs, fleet + serving loopback selftests, wf_top/wf_slo over"
-    echo "the aggregator dir; all without jax. wf_progcheck: 2 without jax,"
-    echo "--explain still answers)"
+    echo "wf_state/wf_health/wf_trace/wf_fleet/wf_top/wf_serve/wf_profile 2"
+    echo "on missing inputs, fleet + serving loopback selftests, wf_top/"
+    echo "wf_slo over the aggregator dir, per-tenant wire-to-sink report;"
+    echo "all without jax. wf_progcheck: 2 without jax, --explain still"
+    echo "answers; wf_profile --capture: 2 without jax)"
 }
 run_step "stdlib CLIs" stdlib_cli_contracts
 

@@ -1,0 +1,278 @@
+#!/usr/bin/env python3
+"""wf_profile — profile-on-page inspection + bounded live-capture CLI.
+
+Summarizes the device-profiler evidence a monitoring run committed
+(``WF_PROFILE=1`` — ``observability/profiling.py``) and joins it against
+the snapshot's device-time attribution:
+
+- the **profile ledger**: every committed incident bundle under
+  ``<dir>/incidents/`` with its ``profile.json`` — captured (file list +
+  bytes), skipped (the recorded reason: session guard held, jax
+  unavailable, max captures), or absent (a pre-profile bundle);
+- the **device-time table**: the snapshot's per-stage ``health.device_time``
+  rows (device ms vs host dispatch ms vs ``dispatch_ratio``) with every
+  stage at or past the dispatch-bound threshold flagged as a
+  ``[FUSION CANDIDATE]`` — the cross-reference that turns a raw capture
+  into "this stage's time is launch overhead, fuse it" (the
+  ``wf_health.py`` classifier, rendered next to the capture that proves it
+  on-device).
+
+**Live capture**: ``--capture LOGDIR [--window-ms N]`` opens one bounded
+window through the ONE ``stats.xprof_trace`` session guard right now —
+this path needs an importable ``jax`` (and the real ``windflow_tpu``
+package) and exits 2 without one; a held session surfaces the guard's
+RuntimeError naming the holder.
+
+Produce the inputs with::
+
+    WF_MONITORING=1 WF_SLO=1 WF_PROFILE=1 WF_SERVE=1 python my_service.py
+    python scripts/wf_profile.py --monitoring-dir wf_monitoring
+
+Summary mode is stdlib only (``observability/profiling.py`` +
+``device_health.py`` + ``slo.py`` are loaded by file path — the
+``wf_slo.py`` convention), so it works on any box the artifacts were
+copied to, without JAX installed.
+
+Exit codes: 0 = summary rendered (or capture succeeded), 2 =
+missing/unreadable inputs, capture impossible (no jax / guard held), or
+usage error (``scripts/ci.sh`` pins the poisoned-jax capture path).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs(names=("journal", "device_health", "slo", "profiling")):
+    """Load the observability helper modules by file path under a synthetic
+    package — no windflow_tpu package import, no JAX (the wf_slo.py
+    loader, grown the profiling module)."""
+    obs = os.path.join(REPO, "windflow_tpu", "observability")
+    pkg = sys.modules.get("wf_obs")
+    if pkg is None:
+        pkg = types.ModuleType("wf_obs")
+        pkg.__path__ = [obs]
+        sys.modules["wf_obs"] = pkg
+    for name in names:
+        if f"wf_obs.{name}" in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            f"wf_obs.{name}", os.path.join(obs, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"wf_obs.{name}"] = mod
+        spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return (sys.modules["wf_obs.device_health"], sys.modules["wf_obs.slo"],
+            sys.modules["wf_obs.profiling"])
+
+
+# ------------------------------------------------------------ report pieces
+
+
+def profile_rows(prof_mod, slo_mod, mon_dir):
+    """One row per committed bundle: (bundle name, manifest, profile dict
+    or None)."""
+    bundles, torn = slo_mod.list_incidents(mon_dir)
+    rows = []
+    for man in bundles:
+        rows.append((os.path.basename(man["path"]), man,
+                     prof_mod.load_profile(man["path"])))
+    return rows, torn
+
+
+def ledger_section(rows, torn):
+    lines = ["== profile ledger (committed incident bundles) =="]
+    if not rows and not torn:
+        lines.append("  (no incident bundles captured — enable with "
+                     "WF_MONITORING=1 WF_SLO=1 WF_PROFILE=1)")
+        return lines
+    for name, man, prof in rows:
+        head = f"  {name:<40} slo={man.get('slo')} tick={man.get('tick')}"
+        if prof is None:
+            lines.append(head + "  profile: ABSENT (bundle predates "
+                                "WF_PROFILE or profile.json unreadable)")
+        elif "profile_skipped" in prof:
+            lines.append(head
+                         + f"  profile: SKIPPED ({prof['profile_skipped']})")
+        else:
+            files = prof.get("files", [])
+            total = sum(int(f.get("bytes", 0)) for f in files)
+            lines.append(head + f"  profile: captured "
+                                f"window={prof.get('window_ms', 0):g} ms "
+                                f"files={len(files)} bytes={total}")
+            for f in files[:8]:
+                lines.append(f"      {f.get('name')}  ({f.get('bytes')} B)")
+            if len(files) > 8:
+                lines.append(f"      ... {len(files) - 8} more file(s)")
+    for name in torn:
+        lines.append(f"  {name:<40} TORN (no committed manifest — crash "
+                     f"mid-capture)")
+    return lines
+
+
+def device_time_section(dh, snap):
+    """Per-stage device-time attribution out of the latest snapshot, with
+    the dispatch-bound classifier's fusion candidates flagged inline."""
+    lines = ["== device-time attribution (snapshot health.device_time) =="]
+    health = snap.get("health") or {}
+    dt = health.get("device_time") or {}
+    if not dt:
+        lines.append("  (no device-time rows — enable the health ledger "
+                     "with WF_MONITORING_HEALTH=1 so captures have "
+                     "per-stage rows to land on)")
+        return lines
+    thresh = float(getattr(dh, "DISPATCH_BOUND_RATIO", 0.5))
+    lines.append(f"  {'stage':<28} {'device_ms':>10} {'dispatch_ms':>11} "
+                 f"{'samples':>7} {'ratio':>6}")
+    for label in sorted(dt):
+        row = dt[label] or {}
+        ratio = row.get("dispatch_ratio")
+        flag = ""
+        if isinstance(ratio, (int, float)) and ratio >= thresh:
+            flag = "  [FUSION CANDIDATE]"
+        lines.append(
+            f"  {label:<28} {row.get('device_ms', 0):>10g} "
+            f"{row.get('dispatch_ms', 0):>11g} {row.get('samples', 0):>7} "
+            f"{(f'{ratio:g}' if isinstance(ratio, (int, float)) else '—'):>6}"
+            f"{flag}")
+    bound = health.get("dispatch_bound") or {}
+    if bound:
+        lines.append(f"  dispatch-bound (ratio >= {thresh:g} — host launch "
+                     f"overhead rivals device work; fuse with K>1 "
+                     f"dispatch): {', '.join(sorted(bound))}")
+    return lines
+
+
+def _capture(args) -> int:
+    """One bounded live window through the ONE session guard — needs the
+    real package (and jax); every failure mode is exit 2 with the reason."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)        # scripts/ is sys.path[0], not REPO
+    try:
+        from windflow_tpu.observability.profiling import profile_window
+    except Exception as e:  # noqa: BLE001 — no jax / broken install
+        print(f"wf_profile: cannot import windflow_tpu for a live capture: "
+              f"{type(e).__name__}: {e}\n"
+              f"(--capture opens a jax.profiler window — it needs an "
+              f"importable jax; bundle summaries work without one)",
+              file=sys.stderr)
+        return 2
+    try:
+        summary = profile_window(args.capture, window_ms=args.window_ms)
+    except RuntimeError as e:
+        print(f"wf_profile: capture refused: {e}\n"
+              f"(the ONE stats.xprof_trace session guard is held, or the "
+              f"backend cannot profile — retry when the session closes)",
+              file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"wf_profile: capture failed: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        print(f"wf_profile: captured {summary['window_ms']:g} ms window "
+              f"into {summary['logdir']!r} "
+              f"({len(summary['files'])} file(s))")
+        for f in summary["files"]:
+            print(f"  {f['name']}  ({f['bytes']} B)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_profile",
+        description="windflow_tpu profile-on-page CLI (incident-bundle "
+                    "profile ledger + per-stage device-time attribution; "
+                    "--capture opens one bounded live window)")
+    ap.add_argument("--monitoring-dir", default="wf_monitoring",
+                    help="monitoring output directory (incidents/ + "
+                         "snapshot.json)")
+    ap.add_argument("--bundle", default=None, metavar="DIR",
+                    help="summarize one incident bundle's profile.json "
+                         "instead of the whole ledger")
+    ap.add_argument("--capture", default=None, metavar="LOGDIR",
+                    help="open one bounded jax.profiler window into LOGDIR "
+                         "right now (needs jax; exit 2 without it or when "
+                         "the one xprof session guard is held)")
+    ap.add_argument("--window-ms", type=float, default=None,
+                    help="capture window for --capture (default: "
+                         "WF_PROFILE_WINDOW_MS, else the built-in default)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    try:
+        dh, slo_mod, prof_mod = _load_obs()
+    except (OSError, ImportError, SyntaxError) as e:
+        print(f"wf_profile: cannot load observability helpers from "
+              f"{REPO!r}: {type(e).__name__}: {e}\n"
+              f"(keep scripts/wf_profile.py next to its windflow_tpu tree — "
+              f"it reuses the bundle/profile readers by file path)",
+              file=sys.stderr)
+        return 2
+
+    if args.window_ms is None:
+        env = os.environ.get("WF_PROFILE_WINDOW_MS", "")
+        args.window_ms = float(env) if env else prof_mod.DEFAULT_WINDOW_MS
+    if args.capture:
+        return _capture(args)
+
+    if args.bundle:
+        prof = prof_mod.load_profile(args.bundle)
+        if prof is None:
+            print(f"wf_profile: no readable profile.json under "
+                  f"{args.bundle!r}\n(a committed bundle carries either a "
+                  f"capture summary or a profile_skipped reason once "
+                  f"WF_PROFILE is on — this bundle has neither)",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(prof, indent=1, sort_keys=True))
+        return 0
+
+    if not os.path.isdir(args.monitoring_dir):
+        print(f"wf_profile: monitoring directory {args.monitoring_dir!r} "
+              f"does not exist\n(run with WF_MONITORING=1 WF_SLO=1 "
+              f"WF_PROFILE=1, or point --monitoring-dir / --bundle at "
+              f"copied artifacts)", file=sys.stderr)
+        return 2
+    try:
+        snap, _series = dh.load_snapshots(args.monitoring_dir)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"wf_profile: cannot load snapshots from "
+              f"{args.monitoring_dir!r}: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    rows, torn = profile_rows(prof_mod, slo_mod, args.monitoring_dir)
+
+    if args.json:
+        print(json.dumps({
+            "monitoring_dir": args.monitoring_dir,
+            "bundles": [{"bundle": name, "slo": man.get("slo"),
+                         "tick": man.get("tick"), "profile": prof}
+                        for name, man, prof in rows],
+            "torn": torn,
+            "device_time": (snap.get("health") or {}).get("device_time"),
+            "dispatch_bound": (snap.get("health") or {}).get(
+                "dispatch_bound"),
+        }, indent=1, sort_keys=True, default=str))
+        return 0
+
+    captured = sum(1 for _n, _m, p in rows
+                   if p is not None and "profile_skipped" not in p)
+    print(f"wf_profile: {args.monitoring_dir!r} — {len(rows)} bundle(s), "
+          f"{captured} with device captures")
+    print()
+    print("\n".join(ledger_section(rows, torn)))
+    print()
+    print("\n".join(device_time_section(dh, snap)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,11 +255,22 @@ def cmd_status(args) -> int:
         code, slo_name = worst.get(tid, (None, None))
         state = _STATE.get(code, "—") if code is not None else "—"
         rate = row.get("rate")
+        # tenant latency joined to the tenant-labelled SLO on the same
+        # line: the p99 the latency spec reads, next to the state it drove
+        lat = ""
+        if row.get("e2e_samples"):
+            lat = (f"p50={row.get('e2e_p50_ms', 0):g}ms "
+                   f"p95={row.get('e2e_p95_ms', 0):g}ms "
+                   f"p99={row.get('e2e_p99_ms', 0):g}ms ")
+            ex = row.get("e2e_p99_exemplar")
+            if isinstance(ex, int):
+                lat += f"p99_trace={ex:#x} "
         print(f"  tenant {tid:<14} offered={row.get('offered', 0):g} "
               f"admitted={row.get('admitted', 0):g} "
               f"shed={row.get('shed', 0):g} "
               f"shed_tuples={row.get('shed_tuples', 0):g} "
               f"rate={f'{rate:g}' if rate is not None else 'unlim'}  "
+              f"{lat}"
               f"slo={state}{f' ({slo_name})' if slo_name else ''}")
     return 0
 

@@ -278,9 +278,11 @@ def serving_panel(snap, series):
     """The serving front door at a glance: which graph is live (and how
     many hot-swaps got it there), the socket framing health, and one row
     per tenant — admit/shed counters, the bucket's current rate (the knob
-    tenant_rate remediation turns), and that tenant's worst SLO state
-    (joined on the per-SLO rows' ``tenant`` label, so a paging tenant is
-    flagged on the same line as its shed counters)."""
+    tenant_rate remediation turns), the e2e latency p99 + its exemplar
+    trace id (``wf_trace.py --batch`` follows it; ``[SLOW]`` when the
+    windowed p99 runs >= 2x the lifetime p99), and that tenant's worst
+    SLO state (joined on the per-SLO rows' ``tenant`` label, so a paging
+    tenant is flagged on the same line as its shed counters)."""
     srv = snap.get("serving") or {}
     if not srv:
         return None
@@ -310,19 +312,31 @@ def serving_panel(snap, series):
             t = row["tenant"]
             if code >= worst.get(t, (-1, ""))[0]:
                 worst[t] = (code, name)
+        # windowed p99 vs the cumulative one: a tenant whose last-tick p99
+        # runs >= 2x its lifetime p99 is slow RIGHT NOW — flag it even
+        # before the latency SLO's burn windows confirm
         lines.append(f"  {'tenant':<14} {'offered':>8} {'admitted':>9} "
-                     f"{'shed':>6} {'tuples shed':>11} {'rate':>8}  slo")
+                     f"{'shed':>6} {'tuples shed':>11} {'rate':>8} "
+                     f"{'p99 ms':>8} {'exemplar':>10}  slo")
         for tid in sorted(tenants):
             row = tenants[tid]
             code, slo_name = worst.get(tid, (None, None))
             state = _STATE.get(code, "—") if code is not None else "—"
             flag = {"page": "  [PAGE]", "warn": "  [WARN]"}.get(state, "")
             rate = row.get("rate")
+            p99 = row.get("e2e_p99_ms")
+            p99t = row.get("e2e_p99_tick_ms")
+            if isinstance(p99, (int, float)) and isinstance(
+                    p99t, (int, float)) and p99 > 0 and p99t >= 2 * p99:
+                flag = "  [SLOW]" + flag
+            ex = row.get("e2e_p99_exemplar")
             lines.append(
                 f"  {tid:<14} {row.get('offered', 0):>8g} "
                 f"{row.get('admitted', 0):>9g} {row.get('shed', 0):>6g} "
                 f"{row.get('shed_tuples', 0):>11g} "
-                f"{(f'{rate:g}' if rate is not None else 'unlim'):>8}  "
+                f"{(f'{rate:g}' if rate is not None else 'unlim'):>8} "
+                f"{(f'{p99:g}' if isinstance(p99, (int, float)) else '—'):>8} "
+                f"{(f'{int(ex):#x}' if isinstance(ex, int) else '—'):>10}  "
                 f"{state}{f' ({slo_name})' if slo_name else ''}{flag}")
     return lines
 

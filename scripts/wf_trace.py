@@ -5,8 +5,10 @@ Converts a tracing run's artifacts into a Chrome trace-event file (Perfetto /
 chrome://tracing loadable — drop it next to an ``xprof_trace`` capture) and,
 with ``--report``, prints the critical-path breakdown: per-stage service vs
 SPSC queue wait vs governor throttle vs supervised restart/shed attribution,
-plus a drill-down of the slowest traced batches and the p99 exemplar from the
-metrics snapshot.
+a per-tenant wire-to-sink section when the flight records carry serving
+ingest extras (wire vs queue vs service vs e2e per tenant, shed-at-admission
+counts, the slowest request's segment verdict), plus a drill-down of the
+slowest traced batches and the p99 exemplar from the metrics snapshot.
 
 Inputs (produced by a run with ``trace=``/``WF_TRACE`` on; the journal and
 snapshot pieces appear when ``monitoring=``/``WF_MONITORING`` ran too):

@@ -27,7 +27,7 @@ from windflow_tpu.observability import device_health as dh
 #: every env var any toggle row touches — cleared for the baseline build
 _TOGGLE_ENVS = ("WF_MONITORING", "WF_MONITORING_HEALTH",
                 "WF_MONITORING_EVENT_TIME", "WF_SLO", "WF_TELEMETRY",
-                "WF_REMEDIATION", "WF_SERVE")
+                "WF_REMEDIATION", "WF_SERVE", "WF_PROFILE")
 
 #: toggle -> env set; ``health`` additionally activates a live
 #: HealthLedger around build+trace (the ledger hooks chain tracing)
@@ -41,6 +41,7 @@ TOGGLES = {
     "remediation": {"WF_MONITORING": "1", "WF_SLO": "1",
                     "WF_REMEDIATION": "1"},
     "serving": {"WF_MONITORING": "1", "WF_SERVE": "1"},
+    "profile": {"WF_MONITORING": "1", "WF_SLO": "1", "WF_PROFILE": "1"},
 }
 
 

@@ -1,0 +1,513 @@
+"""Request-scoped serving observability (PR 20): the wire-to-sink tracing
+stamp (t_send/span meta keys, unknown-meta-key forward compat in BOTH
+directions, SocketSource wire coordinates), per-tenant latency histograms +
+the tenant_e2e_p99_ms SLO signal + fleet federation fold, profile-on-page
+(ProfileOnPage through the ONE xprof session guard, engine commit-before-
+manifest, config resolution + the WF120 validator), THE loopback acceptance
+(a wire-stalled noisy tenant drives its tenant-labelled latency SLO
+OK -> WARN -> PAGE -> OK with exactly one profile-bearing bundle while the
+quiet tenant never leaves OK), and the four-driver byte-identity pin with
+tracing + latency + profile armed."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.analysis import validate
+from windflow_tpu.nexmark import make_query
+from windflow_tpu.observability import (MetricsRegistry, MonitoringConfig,
+                                        TraceConfig, set_journal,
+                                        device_health as dh, profiling,
+                                        slo as slo_mod, tracing)
+from windflow_tpu.serving import (RecordClient, RecordFrameDecoder,
+                                  ServingRuntime, SocketSource,
+                                  encode_record_frame)
+from windflow_tpu.serving import framing as framing_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BATCH = 32
+DT = np.dtype([("key", np.int32), ("ts", np.int64), ("v", np.float32)])
+
+_PROFILE_ENVS = ("WF_PROFILE", "WF_PROFILE_WINDOW_MS",
+                 "WF_PROFILE_MAX_CAPTURES", "WF_MONITORING", "WF_SLO")
+
+
+@pytest.fixture(autouse=True)
+def _clean_hooks():
+    yield
+    dh.set_active(None)
+    set_journal(None)
+
+
+def _chunks(n, base=0.0, batch=BATCH):
+    out = []
+    for i in range(n):
+        rec = np.zeros(batch, dtype=DT)
+        rec["key"] = np.arange(batch) % 4
+        rec["ts"] = np.arange(i * batch, (i + 1) * batch)
+        rec["v"] = base + np.arange(i * batch, (i + 1) * batch,
+                                    dtype=np.float32)
+        out.append(rec)
+    return out
+
+
+def _ops():
+    return [wf.Map(lambda t: {"v": t.v * 2.0 + 1.0})]
+
+
+def _collect(acc):
+    def cb(view):
+        if view is not None:
+            acc.extend(zip(view["id"].tolist(),
+                           np.asarray(view["payload"]["v"]).tolist()))
+    return cb
+
+
+# ------------------------------------------------- wire stamp + forward compat
+
+
+def test_frame_stamp_roundtrip_and_unknown_meta_forward_compat():
+    """The no-flag-day pin, both directions: a stamped (new-client) frame
+    decodes on any server with the stamp in meta; a frame from a FUTURE
+    client carrying meta keys this decoder has never heard of stays fully
+    valid; an unstamped (old-client) frame carries NO stamp keys at all."""
+    rec = b"r" * 24
+    dec = RecordFrameDecoder()
+    wire = encode_record_frame(rec, tenant="a", seq=3,
+                               t_send=123.25, span="a/3")
+    (meta, blob), = dec.feed(wire)
+    assert meta["t_send"] == 123.25 and meta["span"] == "a/3"
+    assert meta["tenant"] == "a" and meta["seq"] == 3 and blob == rec
+    # new-client -> old-server stood in by a future client here: unknown
+    # meta keys pass through untouched, never torn
+    fut = {"tenant": "a", "seq": 4, "kind": "data", "nbytes": len(rec),
+           "t_send": 1.0, "span": "a/4", "hop_count": 3,
+           "compression": "none"}
+    payload = json.dumps(fut).encode() + b"\n" + rec
+    raw = framing_mod.MAGIC + b"%08x" % len(payload) + b"\n" + payload + b"\n"
+    (meta2, blob2), = dec.feed(raw)
+    assert meta2["hop_count"] == 3 and meta2["compression"] == "none"
+    assert blob2 == rec
+    # old-client -> new-server: pre-stamp frames have neither key
+    (meta3, _), = dec.feed(encode_record_frame(rec, tenant="a", seq=5))
+    assert "t_send" not in meta3 and "span" not in meta3
+    assert dec.frames_decoded == 3 and dec.frames_torn == 0
+    # the client-side kill switch reproduces pre-stamp clients exactly
+    assert RecordClient("tcp://127.0.0.1:1").stamp is True
+    assert RecordClient("tcp://127.0.0.1:1", stamp=False).stamp is False
+
+
+def test_socket_source_records_wire_coordinates():
+    """Receipt stamping: a stamped client's frame surfaces
+    ``last_wire = {seq, t_send, t_recv, span}`` at drive pickup with
+    client-before-server wall ordering; an unstamped client still gets the
+    receipt half (t_recv) so queue time stays attributable."""
+    chunks = _chunks(1)
+    for stamp in (True, False):
+        src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                           ts_field="ts", num_keys=4).start()
+        client = RecordClient(src.endpoint, stamp=stamp)
+        t_before = time.time()  # wf-lint: allow[wall-clock] cross-process wire timing needs wall time
+        client.send(chunks[0].tobytes(), tenant="a")
+        client.send_eos("a")
+        client.close()
+        wires = []
+        for _b in src.batches(BATCH):
+            wires.append(dict(src.last_wire or {}))
+        src.close()
+        assert len(wires) == 1
+        w = wires[0]
+        t_after = time.time()  # wf-lint: allow[wall-clock] cross-process wire timing needs wall time
+        assert w["seq"] == 0
+        assert t_before <= w["t_recv"] <= t_after
+        if stamp:
+            assert w["span"] == "a/0"
+            assert t_before <= w["t_send"] <= w["t_recv"]
+        else:
+            assert w["t_send"] is None and w["span"] is None
+
+
+# ------------------------------------------------------ per-tenant latency
+
+
+def test_registry_tenant_latency_rows_and_prometheus():
+    reg = MetricsRegistry("g")
+    reg.attach_serving(lambda: {"graph": "v1", "swaps_applied": 0,
+                                "tenants": {"a": {"offered": 2, "shed": 0}}})
+    # latency never sampled: the tenant row keeps its exact PR 18 shape
+    snap0 = reg.snapshot()
+    assert "e2e_p99_ms" not in snap0["serving"]["tenants"]["a"]
+    reg.record_tenant_e2e("a", 0.050, exemplar=0x123)
+    snap1 = reg.snapshot()
+    row = snap1["serving"]["tenants"]["a"]
+    assert row["e2e_samples"] == 1 and row["offered"] == 2
+    assert 20.0 < row["e2e_p99_ms"] < 150.0      # log-bucket tolerance, 50 ms
+    assert row["e2e_p50_ms"] <= row["e2e_p95_ms"] <= row["e2e_p99_ms"]
+    assert row["e2e_p99_exemplar"] == 0x123
+    assert "e2e_samples_tick" not in row          # no previous tick yet
+    reg.record_tenant_e2e("a", 0.001)
+    snap2 = reg.snapshot()
+    row2 = snap2["serving"]["tenants"]["a"]
+    assert row2["e2e_samples"] == 2 and row2["e2e_samples_tick"] == 1
+    # the windowed p99 sees only the fast tail sample — the recovery signal
+    assert row2["e2e_p99_tick_ms"] < row2["e2e_p99_ms"]
+    text = reg.to_prometheus(snap2)
+    assert 'windflow_tenant_e2e_p99_ms{graph="g",tenant="a"}' in text
+    assert 'windflow_tenant_e2e_samples{graph="g",tenant="a"} 2' in text
+
+
+def test_merge_snapshots_folds_tenant_latency():
+    """Fleet federation: percentiles fold MAX (a fleet p99 can only be as
+    good as its worst host), sample counts sum, the p99 exemplar follows
+    the worst host, and rate keeps its MIN sense."""
+    def host(p99, samples, ex, offered):
+        return {"graph": "g", "operators": [],
+                "serving": {"graph": "v1", "tenants": {"a": {
+                    "offered": offered, "shed": 0, "shed_tuples": 0,
+                    "e2e_p50_ms": p99 / 4, "e2e_p95_ms": p99 / 2,
+                    "e2e_p99_ms": p99, "e2e_samples": samples,
+                    "e2e_p99_exemplar": ex, "e2e_samples_tick": samples,
+                    "e2e_p99_tick_ms": p99, "rate": 8.0}}}}
+    m = dh.merge_snapshots([host(20.0, 10, 111, 6), host(50.0, 3, 222, 4)],
+                           hosts=["h0", "h1"])
+    row = m["serving"]["tenants"]["a"]
+    assert row["e2e_p99_ms"] == 50.0 and row["e2e_p99_tick_ms"] == 50.0
+    assert row["e2e_p50_ms"] == 12.5 and row["e2e_p95_ms"] == 25.0
+    assert row["e2e_samples"] == 13 and row["e2e_samples_tick"] == 13
+    assert row["e2e_p99_exemplar"] == 222         # the worst host's exemplar
+    assert row["offered"] == 10 and row["rate"] == 8.0
+
+
+def test_tenant_e2e_signal_windowed_then_cumulative():
+    fn, mode = slo_mod.TENANT_SIGNALS["tenant_e2e_p99_ms"]
+    assert mode == "max"
+
+    def snap(row):
+        return {"serving": {"tenants": {"a": row}}}
+    # windowed form preferred once a previous tick exists
+    assert fn(snap({"e2e_samples": 9, "e2e_p99_ms": 500.0,
+                    "e2e_samples_tick": 3, "e2e_p99_tick_ms": 4.0}),
+              {}, "a") == 4.0
+    # no traffic this tick: None — the burn windows hold, neither
+    # violating nor clearing
+    assert fn(snap({"e2e_samples": 9, "e2e_p99_ms": 500.0,
+                    "e2e_samples_tick": 0, "e2e_p99_tick_ms": 0.0}),
+              {}, "a") is None
+    # first tick: cumulative fallback
+    assert fn(snap({"e2e_samples": 9, "e2e_p99_ms": 500.0}), {}, "a") == 500.0
+    # latency sampling off / ghost tenant
+    assert fn(snap({"offered": 3}), {}, "a") is None
+    assert fn(snap({"e2e_samples": 9, "e2e_p99_ms": 1.0}), {}, "ghost") is None
+    # the signal rides the tenant-spec grammar (tenant= required)
+    ok = slo_mod.SLOSpec("lat", "tenant_e2e_p99_ms", target=30.0, tenant="a")
+    assert slo_mod.spec_problems(ok) == []
+    bad = slo_mod.SLOSpec("lat", "tenant_e2e_p99_ms", target=30.0)
+    assert any("tenant" in p for p in slo_mod.spec_problems(bad))
+
+
+# --------------------------------------------------------- profile-on-page
+
+
+def _snap_p99(p99_ms, samples=5):
+    return {"graph": "t", "operators": [],
+            "e2e_latency_us": {"p99": p99_ms * 1e3, "p99_tick": p99_ms * 1e3,
+                               "samples": samples, "samples_tick": samples}}
+
+
+def _lat_spec():
+    return slo_mod.SLOSpec(name="latency", signal="e2e_p99_ms", target=30.0,
+                           objective=0.5, fast_window=2, slow_window=4,
+                           warn_burn=1.0, page_burn=2.0)
+
+
+def test_engine_commits_profiler_evidence_before_manifest(tmp_path):
+    """The SLOEngine.profiler hook: its return value lands as profile.json
+    INSIDE the committed bundle (listed in the manifest, which stays LAST);
+    a hook that raises degrades to a recorded skip reason, never a failed
+    tick or a torn bundle."""
+    eng = slo_mod.SLOEngine([_lat_spec()], str(tmp_path / "a"),
+                            journal=False, clock=lambda: 0.0)
+    seen = []
+    eng.profiler = lambda d: (seen.append(d),
+                              {"window_ms": 1.0, "logdir": d,
+                               "files": [{"name": "x.pb", "bytes": 3}]})[1]
+    for _ in range(4):
+        eng.observe(_snap_p99(500.0))
+    bundles, torn = slo_mod.list_incidents(str(tmp_path / "a"))
+    assert len(bundles) == 1 and not torn
+    man = bundles[0]
+    assert "profile.json" in man["files"] and not man["missing"]
+    prof = profiling.load_profile(man["path"])
+    assert prof["files"][0]["name"] == "x.pb"
+    # the capture target lives INSIDE the bundle directory
+    assert seen == [os.path.join(man["path"], "profile")]
+
+    class _Boom:
+        def __call__(self, d):
+            raise RuntimeError("device went away")
+    eng2 = slo_mod.SLOEngine([_lat_spec()], str(tmp_path / "b"),
+                             journal=False, clock=lambda: 0.0)
+    eng2.profiler = _Boom()
+    for _ in range(4):
+        eng2.observe(_snap_p99(500.0))
+    bundles2, torn2 = slo_mod.list_incidents(str(tmp_path / "b"))
+    assert len(bundles2) == 1 and not torn2
+    prof2 = profiling.load_profile(bundles2[0]["path"])
+    assert "device went away" in prof2["profile_skipped"]
+
+
+def test_profile_on_page_respects_the_one_session_guard(tmp_path):
+    """The one-session-guard satellite: a held ``stats.xprof_trace`` is a
+    recorded skip reason (naming the holder) out of ProfileOnPage, and a
+    raised RuntimeError out of the programmatic ``profile_window``; skipped
+    attempts still count against max_captures (a backend that refuses must
+    not be retried on every subsequent page)."""
+    from windflow_tpu.stats import xprof_trace
+    outer = str(tmp_path / "outer")
+    hook = profiling.ProfileOnPage(
+        profiling.ProfileConfig(window_ms=1.0, max_captures=2))
+    with xprof_trace(outer):
+        prof = hook(str(tmp_path / "p1"))
+        assert "profile_skipped" in prof
+        assert "outer" in prof["profile_skipped"]      # names the holder
+        with pytest.raises(RuntimeError, match="already"):
+            profiling.profile_window(str(tmp_path / "p2"), window_ms=1.0)
+    assert hook.captures == 1
+    hook(str(tmp_path / "p3"))                         # attempt 2 of 2
+    prof3 = hook(str(tmp_path / "p4"))
+    assert prof3["profile_skipped"].startswith("max captures")
+    assert hook.captures == 2                          # attempt not spent
+
+
+def test_profile_config_resolution(monkeypatch, tmp_path):
+    for env in _PROFILE_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    assert profiling.resolve_profile(None) is None
+    assert profiling.resolve_profile(False) is None
+    assert profiling.resolve_profile(True).window_ms == \
+        profiling.DEFAULT_WINDOW_MS
+    monkeypatch.setenv("WF_PROFILE", "1")
+    monkeypatch.setenv("WF_PROFILE_WINDOW_MS", "7.5")
+    monkeypatch.setenv("WF_PROFILE_MAX_CAPTURES", "5")
+    cfg = profiling.resolve_profile(None)
+    assert cfg.window_ms == 7.5 and cfg.max_captures == 5
+    monkeypatch.setenv("WF_PROFILE", "0")
+    assert profiling.resolve_profile(None) is None
+    with pytest.raises(ValueError):
+        profiling.ProfileConfig(window_ms=0.0)
+    with pytest.raises(ValueError):
+        profiling.ProfileConfig(max_captures=0)
+    # structural misconfigurations raise at resolve (the WF118 discipline):
+    # profile without the SLO engine, and a window reaching the interval
+    for env in ("WF_PROFILE", "WF_PROFILE_WINDOW_MS",
+                "WF_PROFILE_MAX_CAPTURES"):
+        monkeypatch.delenv(env, raising=False)
+    with pytest.raises(ValueError, match="WF120"):
+        MonitoringConfig.resolve(MonitoringConfig(
+            out_dir=str(tmp_path / "m1"), profile=True))
+    with pytest.raises(ValueError, match="WF120"):
+        MonitoringConfig.resolve(MonitoringConfig(
+            out_dir=str(tmp_path / "m2"), interval_s=0.1, slo=True,
+            profile=profiling.ProfileConfig(window_ms=250.0)))
+    ok = MonitoringConfig.resolve(MonitoringConfig(
+        out_dir=str(tmp_path / "m3"), slo=True,
+        profile=profiling.ProfileConfig(window_ms=5.0)))
+    assert ok.profile.window_ms == 5.0
+
+
+def test_validator_reports_wf120(monkeypatch):
+    for env in _PROFILE_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    chunks = _chunks(2)
+
+    def mk():
+        return wf.Pipeline(
+            wf.RecordSource(lambda: iter(chunks), DT, key_field="key",
+                            ts_field="ts", num_keys=4),
+            _ops(), wf.Sink(lambda v: None), batch_size=BATCH)
+    p = mk()                         # built with a clean env: the validator
+    #                                  resolves the CURRENT env at run time
+    # WF_PROFILE set while monitoring itself resolves off: dead toggle
+    monkeypatch.setenv("WF_PROFILE", "1")
+    assert "WF120" in validate(p).codes()
+    # monitoring on but the SLO engine off: the config cannot resolve —
+    # the validator reports it, and the constructor mirrors it (the WF118
+    # discipline: a pipeline built under the bad env refuses loudly)
+    monkeypatch.setenv("WF_MONITORING", "1")
+    assert "WF120" in validate(p).codes()
+    with pytest.raises(ValueError, match="WF120"):
+        mk()
+    # fully armed (slo on, window under the interval, jax importable): clean
+    monkeypatch.setenv("WF_SLO", "1")
+    report = validate(mk())
+    assert "WF120" not in report.codes()
+
+
+# ------------------------------------------- THE loopback acceptance loop
+
+
+def test_acceptance_wire_stalled_tenant_pages_with_profile(tmp_path):
+    """THE acceptance loop, wire-to-sink edition: the noisy tenant's frames
+    arrive stamped 250 ms in the past (a deterministic wire stall — no
+    sleeps), driving ITS tenant-labelled latency SLO OK -> WARN -> PAGE;
+    the stall lifting recovers it to OK; exactly one cooldown-limited
+    bundle commits WITH the profile artifact; the quiet tenant never leaves
+    OK and never sheds; and the flight-recorder report attributes the
+    noisy tenant's time to the WIRE segment."""
+    mon = str(tmp_path / "mon")
+    trace_dir = str(tmp_path / "trace")
+    stall_s = 0.25
+    spec = dict(signal="tenant_e2e_p99_ms", target=30.0, objective=0.5,
+                fast_window=3, slow_window=6, warn_burn=1.0, page_burn=2.0)
+    cfg = MonitoringConfig(
+        out_dir=mon, interval_s=0.05, e2e_sample_every=1,
+        slo=[dict(spec, name="lat-noisy", tenant="noisy"),
+             dict(spec, name="lat-quiet", tenant="quiet")],
+        profile=profiling.ProfileConfig(window_ms=5.0, max_captures=1))
+    got = []
+    src = SocketSource("tcp://127.0.0.1:0", DT, key_field="key",
+                       ts_field="ts", num_keys=4, replay=128)
+    rt = ServingRuntime(
+        src, _ops(), wf.Sink(_collect(got)), batch_size=BATCH,
+        serving={"tenants": [{"id": "quiet"}, {"id": "noisy"}]},
+        monitoring=cfg)
+    tracer = tracing.Tracer(TraceConfig(out_dir=trace_dir), "serve").start()
+    src.start()
+    thread = rt.run_background()
+    quiet_client = RecordClient(src.endpoint)
+    noisy_sock = framing_mod.connect(src.endpoint)
+    quiet_chunks = _chunks(28, base=10_000.0)
+    noisy_chunks = _chunks(28, base=0.0)
+    try:
+        for i in range(28):
+            quiet_client.send(quiet_chunks[i].tobytes(), tenant="quiet")
+            # first 10 frames: stamped in the PAST — the wire segment
+            # carries the stall; then the stall lifts
+            t_send = time.time() - (stall_s if i < 10 else 0.0)  # wf-lint: allow[wall-clock] cross-process wire timing needs wall time
+            noisy_sock.sendall(encode_record_frame(
+                noisy_chunks[i].tobytes(), tenant="noisy", seq=i,
+                t_send=t_send, span=f"noisy/{i}"))
+            time.sleep(0.06)
+        quiet_client.send_eos("quiet")
+    finally:
+        quiet_client.close()
+        noisy_sock.close()
+    thread.join(timeout=120.0)
+    assert not thread.is_alive()
+    if rt.background_error is not None:
+        raise rt.background_error
+    tracer.finish()
+
+    # every record delivered, transformed, nobody shed
+    want = sorted(2.0 * v + 1.0
+                  for c in quiet_chunks + noisy_chunks
+                  for v in c["v"].tolist())
+    assert sorted(v for _, v in got) == want
+    rows = rt.serving_section()["tenants"]
+    assert rows["quiet"]["shed"] == 0 and rows["noisy"]["shed"] == 0
+
+    series = [json.loads(l)
+              for l in open(os.path.join(mon, "snapshots.jsonl"))]
+    noisy_states = [s["slo"]["lat-noisy"]["state"]
+                    for s in series if "slo" in s]
+    quiet_states = [s["slo"]["lat-quiet"]["state"]
+                    for s in series if "slo" in s]
+    # the tenant label rides the SLO row into every snapshot
+    tagged = next(s["slo"]["lat-noisy"] for s in series if "slo" in s)
+    assert tagged["tenant"] == "noisy"
+    # noisy: strictly OK -> WARN -> PAGE -> OK, no re-page after recovery
+    assert noisy_states[0] == "ok"
+    i_warn = noisy_states.index("warn")
+    i_page = noisy_states.index("page")
+    assert i_warn < i_page
+    assert noisy_states[-1] == "ok"
+    i_ok = noisy_states.index("ok", i_page)
+    assert "page" not in noisy_states[i_ok:]
+    # quiet: never leaves OK while its neighbor burns
+    assert set(quiet_states) == {"ok"}
+
+    # exactly ONE committed bundle, carrying the profile artifact
+    bundles, torn = slo_mod.list_incidents(mon)
+    assert len(bundles) == 1 and not torn
+    man = bundles[0]
+    assert man["slo"] == "lat-noisy" and "profile.json" in man["files"]
+    prof = profiling.load_profile(man["path"])
+    # a CPU/TPU box captures for real; a box whose backend refuses records
+    # why — either way the bundle carries the evidence
+    assert prof.get("files") or "profile_skipped" in prof
+
+    # the per-tenant latency rows landed in the final snapshot
+    snap = json.load(open(os.path.join(mon, "snapshot.json")))
+    trow = snap["serving"]["tenants"]
+    assert trow["noisy"]["e2e_samples"] > 0
+    assert trow["noisy"]["e2e_p99_ms"] > 100.0      # the stall dominates
+    assert trow["quiet"]["e2e_p99_ms"] < trow["noisy"]["e2e_p99_ms"]
+    assert "e2e_p99_exemplar" in trow["noisy"]
+
+    # wire-to-sink attribution: the report blames the WIRE segment for the
+    # noisy tenant, with per-request coordinates joined
+    records, meta = tracing.load_flight(trace_dir)
+    report = tracing.critical_path_report(records, [], snap, meta)
+    assert "per-tenant wire-to-sink attribution" in report
+    lines = report.splitlines()
+    i = next(idx for idx, l in enumerate(lines) if "tenant 'noisy'" in l)
+    block = "\n".join(lines[i:i + 7])
+    assert "slowest segment: wire" in block
+    assert "seq=" in block
+    assert any("tenant 'quiet'" in l for l in lines)
+
+
+# ------------------------------------------------ four-driver byte identity
+
+
+def _run_q3(driver, monitoring=False, trace=None):
+    src, ops = make_query("q3_enrich_join", 300)
+    rows = []
+
+    def cb(view):
+        if view is None:
+            return
+        rows.append((np.asarray(view["key"]).tolist(),
+                     np.asarray(view["id"]).tolist(),
+                     np.asarray(view["ts"]).tolist()))
+    sink = wf.Sink(cb)
+    kw = dict(monitoring=monitoring)
+    if trace is not None:
+        kw["trace"] = trace
+    if driver == "plain":
+        wf.Pipeline(src, ops, sink, batch_size=64, **kw).run()
+    else:
+        g = wf.PipeGraph(batch_size=64, **kw)
+        mp = g.add_source(src)
+        for op in ops:
+            mp.add(op)
+        mp.add_sink(sink)
+        if driver == "graph":
+            g.run()
+        elif driver == "graph-threaded":
+            g.run(threaded=True)
+        elif driver == "graph-supervised":
+            g.run_supervised(checkpoint_every=2, backoff_base=0.001,
+                             backoff_cap=0.01)
+    return rows
+
+
+@pytest.mark.parametrize("driver", ["plain", "graph", "graph-threaded",
+                                    "graph-supervised"])
+def test_tracing_latency_profile_on_results_byte_identical(tmp_path, driver):
+    """tracing + per-request latency sampling + an armed (never-firing)
+    profile hook must not change a single result byte through any of the
+    four drivers — the whole observability stack is host-side work."""
+    base = _run_q3(driver)
+    cfg = MonitoringConfig(
+        out_dir=str(tmp_path / f"m-{driver}"), interval_s=30.0,
+        e2e_sample_every=1,
+        slo=[{"name": "lat", "signal": "e2e_p99_ms", "target": 1e9}],
+        profile=profiling.ProfileConfig(window_ms=5.0))
+    on = _run_q3(driver, monitoring=cfg,
+                 trace=TraceConfig(out_dir=str(tmp_path / f"t-{driver}")))
+    assert on == base

@@ -113,6 +113,15 @@ WF119  error     serving config the run cannot honor
                  live traffic), or an SLO spec whose ``tenant=`` label
                  names an undeclared tenant (the SLO idles at OK
                  forever)
+WF120  error     profile-on-page config the run cannot honor
+                 (``observability/profiling.py``): profiling on
+                 (``profile=``/``WF_PROFILE``) while the SLO engine
+                 resolves off (captures fire from PAGE entry only),
+                 a capture window that reaches the reporter interval
+                 (the capture runs ON the Reporter tick thread, so
+                 such a window stacks ticks), or profiling on under a
+                 box with no importable ``jax`` (every capture would
+                 be recorded as ``profile_skipped``)
 WF114  warn/err  tiered keyed state (``windflow_tpu/state``) combined
                  with a configuration its determinism/sizing contract
                  cannot honor: sequence-id tracing or wall-clock
@@ -983,6 +992,61 @@ def _check_serving(report, stored_serving, stored_monitoring,
                  "label a declared tenant id")
 
 
+def _check_profile(report, stored_monitoring) -> None:
+    """WF120: the profile-on-page mirror of WF118 — resolve the monitoring
+    config exactly as the Monitor will and reject profile configurations
+    the capture path cannot honor before the run starts (the
+    MonitoringConfig/Monitor raise the structural problems at
+    construction; WF120 is the pre-run surface of those PLUS the
+    jax-availability probe only a validator run can usefully report)."""
+    import os
+    from ..observability import MonitoringConfig
+    from ..observability import profiling as _profiling
+    try:
+        cfg = MonitoringConfig.resolve(stored_monitoring)
+    except (ValueError, TypeError) as e:
+        if "profile" in str(e).lower():
+            report.add(
+                "WF120", "error", "monitoring.profile",
+                f"monitoring/profile config does not resolve: "
+                f"{type(e).__name__}: {e}",
+                hint="profile-on-page requires the SLO engine (slo=/WF_SLO) "
+                     "and a capture window below the reporter interval "
+                     "(WF_PROFILE_WINDOW_MS < WF_MONITORING_INTERVAL)")
+        return                          # otherwise WF113's diagnosis
+    if cfg is None:
+        env = os.environ.get("WF_PROFILE", "")
+        if env not in ("", "0"):
+            report.add(
+                "WF120", "error", "monitoring.profile",
+                "WF_PROFILE is set but monitoring itself resolves off — "
+                "profile-on-page rides the SLO engine's incident capture, "
+                "so no profiler window could ever open",
+                hint="enable monitoring alongside the sub-toggle: "
+                     "WF_MONITORING=1 WF_SLO=1 (or monitoring=/"
+                     "MonitoringConfig(slo=..., profile=...) on the driver)")
+        return
+    try:
+        prof = _profiling.resolve_profile(
+            cfg.profile if cfg.profile is not False else None)
+    except (ValueError, TypeError) as e:
+        report.add(
+            "WF120", "error", "monitoring.profile",
+            f"profile config does not resolve: {type(e).__name__}: {e}",
+            hint="profile=/WF_PROFILE accept True/'1' (defaults) or a "
+                 "profiling.ProfileConfig; WF_PROFILE_WINDOW_MS must be a "
+                 "positive number, WF_PROFILE_MAX_CAPTURES an integer >= 1")
+        return
+    for prob in _profiling.profile_problems(
+            prof, slo_on=cfg.slo not in (False, None, "", "0"),
+            interval_s=cfg.interval_s):
+        report.add(
+            "WF120", "error", "monitoring.profile", prob,
+            hint="captures fire from PAGE entry on the Reporter tick "
+                 "thread through the ONE stats.xprof_trace session guard; "
+                 "see observability/profiling.py + scripts/wf_profile.py")
+
+
 def _check_kernel_records(report) -> None:
     """WF109: compare every kernel-impl choice the registry recorded at
     trace time against what it would resolve to NOW (env/tuning-cache as of
@@ -1349,6 +1413,7 @@ def _validate_pipeline(report, p, faults, control, supervised,
     _check_health(report, getattr(p, "_monitoring_arg", None))
     _check_slo(report, getattr(p, "_monitoring_arg", None))
     _check_telemetry(report, getattr(p, "_monitoring_arg", None))
+    _check_profile(report, getattr(p, "_monitoring_arg", None))
     _check_remediation(report, getattr(p, "_monitoring_arg", None), cfg)
     _check_serving(report, getattr(p, "_serving_arg", None),
                    getattr(p, "_monitoring_arg", None), supervised)
@@ -1377,6 +1442,7 @@ def _validate_supervised(report, sp, faults, control, trace=None,
     _check_health(report, getattr(sp, "_monitoring_arg", None))
     _check_slo(report, getattr(sp, "_monitoring_arg", None))
     _check_telemetry(report, getattr(sp, "_monitoring_arg", None))
+    _check_profile(report, getattr(sp, "_monitoring_arg", None))
     _check_remediation_supervised(report, sp)
     _check_serving(report, getattr(sp, "_serving_arg", None),
                    getattr(sp, "_monitoring_arg", None), True)
@@ -1435,6 +1501,7 @@ def _validate_threaded(report, tp, faults, control, supervised,
     _check_health(report, getattr(tp, "_monitoring_arg", None))
     _check_slo(report, getattr(tp, "_monitoring_arg", None))
     _check_telemetry(report, getattr(tp, "_monitoring_arg", None))
+    _check_profile(report, getattr(tp, "_monitoring_arg", None))
     _check_remediation(report, getattr(tp, "_monitoring_arg", None), cfg)
     _check_serving(report, getattr(tp, "_serving_arg", None),
                    getattr(tp, "_monitoring_arg", None), supervised)
@@ -1551,6 +1618,7 @@ def _validate_graph(report, g, faults, control, supervised,
     _check_health(report, getattr(g, "_monitoring_arg", None))
     _check_slo(report, getattr(g, "_monitoring_arg", None))
     _check_telemetry(report, getattr(g, "_monitoring_arg", None))
+    _check_profile(report, getattr(g, "_monitoring_arg", None))
     _check_remediation(report, getattr(g, "_monitoring_arg", None), cfg)
     _check_serving(report, getattr(g, "_serving_arg", None),
                    getattr(g, "_monitoring_arg", None), supervised)
@@ -1658,6 +1726,7 @@ def _validate_serving_runtime(report, rt, faults, control, trace=None,
     _check_health(report, rt._monitoring_arg)
     _check_slo(report, rt._monitoring_arg)
     _check_telemetry(report, rt._monitoring_arg)
+    _check_profile(report, rt._monitoring_arg)
     _check_remediation(report, rt._monitoring_arg, cfg)
     _check_serving(report, rt.config, rt._monitoring_arg, rt._supervised)
 

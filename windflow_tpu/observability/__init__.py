@@ -40,6 +40,11 @@ enabled per graph/pipeline via ``PipeGraph(..., monitoring=...)`` /
                                  # requires the SLO engine; see
                                  # MonitoringConfig.remediation +
                                  # control/remediation.py)
+    WF_PROFILE=1                 # profile-on-page sub-toggle: bounded
+                                 # jax.profiler capture committed into
+                                 # incident bundles (requires the SLO
+                                 # engine; see MonitoringConfig.profile +
+                                 # profiling.py)
 """
 
 from __future__ import annotations
@@ -60,13 +65,13 @@ from .topology import (graph_topology_dot, graph_topology_json,
                        pipeline_topology_dot, pipeline_topology_json,
                        topology_dot, topology_json)
 from .tracing import TraceConfig, Tracer
-from . import journal, tracing
+from . import journal, profiling, tracing
 
 __all__ = [
     "LogHistogram", "MetricsRegistry", "Reporter", "EventJournal",
     "MonitoringConfig", "Monitor", "journal", "read_journal", "set_journal",
     "TraceConfig", "Tracer", "tracing", "event_time", "event_time_enabled",
-    "device_health", "slo_engine",
+    "device_health", "slo_engine", "profiling",
     "topology_dot", "topology_json", "graph_topology_dot",
     "graph_topology_json", "pipeline_topology_dot", "pipeline_topology_json",
 ]
@@ -190,6 +195,22 @@ class MonitoringConfig:
     #: — WF118, loud at construction)
     remediation_cooldown_s: float = 60.0
     remediation_max_actions: int = 8
+    #: profile-on-page sub-toggle (off by default): a bounded
+    #: ``jax.profiler`` capture window committed into every incident
+    #: bundle BEFORE its manifest (``observability/profiling.py``) —
+    #: device-side evidence for a latency PAGE.  Accepts ``True``
+    #: (default window/cap), a :class:`~windflow_tpu.observability.
+    #: profiling.ProfileConfig`, or ``False``.  REQUIRES the SLO engine
+    #: (captures fire from PAGE entry only) and a capture window shorter
+    #: than the reporter interval (the capture runs ON the Reporter tick
+    #: thread) — both are construction-time ValueErrors here and WF120 in
+    #: ``validate()``.  Every capture goes through the ONE
+    #: ``stats.xprof_trace`` session guard; a held session is a
+    #: ``profile_skipped`` reason inside the bundle, never a second
+    #: latch.  Env override: ``WF_PROFILE`` (``''``/``'0'`` off) with
+    #: ``WF_PROFILE_WINDOW_MS`` / ``WF_PROFILE_MAX_CAPTURES``; analyze
+    #: with ``scripts/wf_profile.py``.
+    profile: object = False
 
     def should_sample_e2e(self, n: int) -> bool:
         """THE e2e sampling policy, shared by every driver: every Nth source
@@ -270,6 +291,25 @@ class MonitoringConfig:
         rm = os.environ.get("WF_REMEDIATION_MAX_ACTIONS", "")
         if rm:
             cfg = dataclasses.replace(cfg, remediation_max_actions=int(rm))
+        from . import profiling as _profiling
+        prof = _profiling.resolve_profile(
+            cfg.profile if cfg.profile is not False else None)
+        cfg = dataclasses.replace(cfg, profile=prof if prof else False)
+        if cfg.profile is not False:
+            probs = _profiling.profile_problems(
+                cfg.profile,
+                slo_on=cfg.slo not in (False, None, "", "0"),
+                interval_s=cfg.interval_s)
+            # jax availability is a runtime/WF120 concern (serving hosts
+            # legitimately resolve configs on jax-less boxes — every
+            # capture just records profile_skipped); the structural
+            # problems are construction-time errors like WF118
+            probs = [p for p in probs if "not importable" not in p]
+            if probs:
+                raise ValueError(
+                    "invalid profile-on-page config (the validator "
+                    "reports these as WF120 before the run): "
+                    + "; ".join(probs))
         if cfg.remediation not in (False, None, "", "0"):
             if cfg.slo in (False, None, "", "0"):
                 raise ValueError(
@@ -366,6 +406,23 @@ class Monitor:
                 max_incidents=config.slo_max_incidents,
                 journal_path=journal_path,
                 fingerprint=self._config_fingerprint)
+        #: profile-on-page (MonitoringConfig.profile): bound as the SLO
+        #: engine's profiler hook so PAGE-entry incident captures commit a
+        #: bounded device-profiler window (or its skip reason) into every
+        #: bundle BEFORE the manifest.  Requires the SLO engine — profile
+        #: on while slo resolves off is a construction-time ValueError
+        #: (WF120 pre-run), mirroring remediation's WF118
+        from . import profiling as _profiling
+        prof_cfg = _profiling.resolve_profile(
+            config.profile if config.profile is not False else None)
+        if prof_cfg is not None:
+            if self.slo is None:
+                raise ValueError(
+                    "profile=/WF_PROFILE is on but the SLO engine "
+                    "(slo=/WF_SLO) is off — captures fire from PAGE entry "
+                    "only, so there is nothing to trigger them (WF120 "
+                    "before the run)")
+            self.slo.profiler = _profiling.ProfileOnPage(prof_cfg)
         #: remediation engine (MonitoringConfig.remediation): resolved here
         #: so an unusable policy fails the run loudly at Monitor
         #: construction (the SLO-engine convention; validate() reports it

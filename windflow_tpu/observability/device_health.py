@@ -730,8 +730,25 @@ def merge_snapshots(snaps: Sequence[dict],
                     continue                  # torn/partial host section
                 dst = tenants.setdefault(str(tid), {})
                 rate = row.get("rate")
+                # latency percentiles fold like SLO burn rates — MAX
+                # across hosts (percentiles never sum), the exemplar
+                # follows the worst host's p99; only the sample counters
+                # ride the sum below
+                pct_keys = ("e2e_p50_ms", "e2e_p95_ms", "e2e_p99_ms",
+                            "e2e_p99_tick_ms")
+                for k in pct_keys:
+                    v = row.get(k)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        dst[k] = max(dst.get(k, v), v)
+                p99 = row.get("e2e_p99_ms")
+                if row.get("e2e_p99_exemplar") is not None \
+                        and isinstance(p99, (int, float)) \
+                        and p99 >= dst.get("e2e_p99_ms", p99):
+                    dst["e2e_p99_exemplar"] = row["e2e_p99_exemplar"]
                 _sum_into(dst, {k: v for k, v in row.items()
-                                if k != "rate"})
+                                if k != "rate" and k not in pct_keys
+                                and k != "e2e_p99_exemplar"})
                 if isinstance(rate, (int, float)):
                     dst["rate"] = min(dst.get("rate", rate), rate)
         if graphs:

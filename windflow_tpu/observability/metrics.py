@@ -312,6 +312,15 @@ _TENANT_HELP = {
     "shed": "batches this tenant's controller shed",
     "shed_tuples": "tuple capacity this tenant's shed batches carried",
     "rate": "the tenant bucket's live refill rate",
+    "e2e_p50_ms": "tenant e2e latency p50 (ms, cumulative)",
+    "e2e_p95_ms": "tenant e2e latency p95 (ms, cumulative)",
+    "e2e_p99_ms": "tenant e2e latency p99 (ms, cumulative)",
+    "e2e_p99_tick_ms": "tenant e2e latency p99 over the last reporter tick "
+                       "(ms; the tenant_e2e_p99_ms SLO signal)",
+    "e2e_samples": "tenant e2e latency samples recorded",
+    "e2e_samples_tick": "tenant e2e latency samples in the last tick",
+    "e2e_p99_exemplar": "trace id of a batch observed in the tenant's p99 "
+                        "latency bucket",
 }
 
 
@@ -410,6 +419,14 @@ class MetricsRegistry:
         # the cumulative histogram could never recover below a target once
         # a stall pushed its whole-run p99 over it
         self._e2e_prev_counts: Optional[List[int]] = None  # wf-lint: single-writer[reporter]
+        # per-tenant e2e latency histograms (serving drive loop records,
+        # reporter tick reads): the DICT itself is guarded — first sample
+        # of a new tenant inserts while the reporter iterates — while each
+        # histogram is internally locked like e2e_hist
+        self._tenant_e2e: Dict[str, LogHistogram] = {}  # wf-lint: guarded-by[_lock]
+        # previous tick's per-tenant bucket counts (reporter-only, the
+        # _e2e_prev_counts windowed-p99 discipline per tenant)
+        self._tenant_prev_counts: Dict[str, List[int]] = {}  # wf-lint: single-writer[reporter]
         self._lock = threading.Lock()
 
     # -- registration -----------------------------------------------------------------
@@ -458,6 +475,52 @@ class MetricsRegistry:
 
     def record_e2e(self, seconds: float, exemplar=None) -> None:
         self.e2e_hist.record(seconds, exemplar=exemplar)
+
+    def record_tenant_e2e(self, tenant: str, seconds: float,
+                          exemplar=None) -> None:
+        """One sampled wire-to-sink latency observation for ``tenant``
+        (serving drive loop, same sampling cadence as ``record_e2e``) —
+        feeds the per-tenant p50/p95/p99 rows of ``serving.tenants`` and
+        the ``tenant_e2e_p99_ms`` SLO signal."""
+        with self._lock:
+            h = self._tenant_e2e.get(tenant)
+            if h is None:
+                h = self._tenant_e2e[tenant] = LogHistogram()
+        h.record(seconds, exemplar=exemplar)
+
+    def _tenant_latency_rows(self) -> Dict[str, dict]:
+        """Per-tenant latency keys (names.py::TENANT_GAUGES e2e_* family)
+        merged into the ``serving.tenants`` rows at snapshot time.  Reporter
+        thread only (the _e2e_prev_counts discipline); tenants with zero
+        samples yield nothing, so latency-off snapshots stay byte-identical."""
+        with self._lock:
+            hists = list(self._tenant_e2e.items())
+        out: Dict[str, dict] = {}
+        for tenant, h in hists:
+            counts, count, _sum, _mn, mx, exemplars = h._snap()
+            if not count:
+                continue
+            pct = lambda q: LogHistogram._pct_value(counts, count, mx, q)
+            row = {
+                "e2e_p50_ms": round(pct(50) * 1e3, 3),
+                "e2e_p95_ms": round(pct(95) * 1e3, 3),
+                "e2e_p99_ms": round(pct(99) * 1e3, 3),
+                "e2e_samples": count,
+            }
+            i99 = LogHistogram._bucket_of(counts, count, 99)
+            ex = None if i99 is None else exemplars.get(i99)
+            if ex is not None:
+                row["e2e_p99_exemplar"] = ex
+            prev = self._tenant_prev_counts.get(tenant)
+            if prev is not None:
+                delta = [max(c - p, 0) for c, p in zip(counts, prev)]
+                dn = sum(delta)
+                row["e2e_samples_tick"] = dn
+                row["e2e_p99_tick_ms"] = round(
+                    LogHistogram._pct_value(delta, dn, mx, 99) * 1e3, 3)
+            self._tenant_prev_counts[tenant] = counts
+            out[tenant] = row
+        return out
 
     # -- collection -------------------------------------------------------------------
 
@@ -712,6 +775,14 @@ class MetricsRegistry:
             except Exception:       # noqa: BLE001 — never kill a snapshot
                 sec = None
             if sec:
+                # join per-tenant latency into the tenant rows (tenants the
+                # registry declared but latency never sampled keep their
+                # exact PR 18 shape — the off path stays byte-identical)
+                lat = self._tenant_latency_rows()
+                if lat:
+                    tenants = sec.setdefault("tenants", {})
+                    for tid, extra in lat.items():
+                        tenants.setdefault(tid, {}).update(extra)
                 snap["serving"] = sec
         if self.event_time:
             et = self._event_time_section(et_secs)

@@ -334,6 +334,17 @@ TENANT_GAUGES = (
     "shed",              # batches its controller shed
     "shed_tuples",       # tuple capacity those shed batches carried
     "rate",              # the bucket's live refill rate (remediation moves it)
+    # per-tenant e2e latency (MetricsRegistry.record_tenant_e2e LogHistograms,
+    # sampled on the serving drive loop beside the run-level e2e sample; rows
+    # only carry these keys once the tenant has samples, so the off path stays
+    # byte-identical).  Percentile folds are MAX across hosts (the PR 10 e2e
+    # convention), samples summed, exemplar from the worst host.
+    "e2e_p50_ms", "e2e_p95_ms", "e2e_p99_ms",
+    "e2e_p99_tick_ms",   # windowed p99 over the last reporter tick — THE
+    #                      tenant_e2e_p99_ms SLO signal's read (cumulative
+    #                      p99 can never recover after a stall)
+    "e2e_samples", "e2e_samples_tick",
+    "e2e_p99_exemplar",  # trace id of a batch observed in the p99 bucket
 )
 
 #: kernel families selectable through the per-backend kernel registry

@@ -1,0 +1,218 @@
+"""Profile-on-page — bounded device-profiler capture for incident bundles.
+
+The PR 15 incident machinery commits host-side forensics (sections, burn
+timeline, journal tail, flight-recorder trace) but zero on-device evidence:
+a latency PAGE says *that* a tenant is slow, never *which kernels* its time
+went to.  This module closes that gap with a bounded ``jax.profiler``
+capture window that can fire from PAGE entry (``SLOEngine.profiler``) or be
+opened programmatically (:func:`profile_window`).
+
+Discipline:
+
+- **One session guard.**  Every capture goes through the ONE existing
+  ``windflow_tpu.stats.xprof_trace`` session latch — never a second latch
+  path, never nested: when the guard is held (a user's ``xprof_trace``
+  region, a TensorBoard capture), the incident path records a
+  ``profile_skipped`` reason into the bundle instead of fighting for the
+  profiler, and the programmatic path surfaces the guard's RuntimeError
+  naming the holder (the ``tests/test_tracing.py`` pin).
+- **Bounded + rate-limited.**  A capture window is ``window_ms`` of wall
+  time on the Reporter tick thread, so the validator (WF120) refuses
+  windows that reach the reporter interval (a capture that outlives its
+  tick would stack).  On top of the engine's own cooldown/max-incidents
+  rate limit, :class:`ProfileOnPage` counts its own attempts against
+  ``max_captures`` — a re-paging storm profiles the first incidents, then
+  records skips.
+- **Committed before the manifest.**  The capture lands under
+  ``<bundle>/profile/`` and its summary (``profile.json``) joins the
+  manifest's ``files`` list — the bundle commit point stays LAST, so a
+  committed bundle either carries the capture or says why not.
+
+Stdlib-loadable by file path (the ``slo.py`` convention): ``jax`` and the
+``windflow_tpu.stats`` guard are imported inside function bodies only, so
+``scripts/wf_profile.py`` can load this module on a box with neither.
+
+Env toggles (off by default, the ``WF_*`` convention; ``''``/``'0'`` = off)::
+
+    WF_PROFILE=1                 # profile-on-page inside incident bundles
+    WF_PROFILE_WINDOW_MS=250     # capture window (must stay < reporter tick)
+    WF_PROFILE_MAX_CAPTURES=2    # captures per run, on top of the incident
+                                 # cooldown/max discipline
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import List, Optional, Union
+
+#: capture window default — well under the Reporter's minimum interval
+#: guardrail relative to the 1 s default tick, and long enough to cover
+#: several serving batches on either backend
+DEFAULT_WINDOW_MS = 250.0
+#: captures per run (attempts, not successes: a backend that refuses must
+#: not be retried on every subsequent page)
+DEFAULT_MAX_CAPTURES = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileConfig:
+    """Resolved profile-on-page settings (``MonitoringConfig.profile``)."""
+
+    window_ms: float = DEFAULT_WINDOW_MS
+    max_captures: int = DEFAULT_MAX_CAPTURES
+
+    def __post_init__(self):
+        if float(self.window_ms) <= 0:
+            raise ValueError(f"profile window_ms must be > 0, got "
+                             f"{self.window_ms}")
+        if int(self.max_captures) < 1:
+            raise ValueError(f"profile max_captures must be >= 1, got "
+                             f"{self.max_captures}")
+
+
+def resolve_profile(profile: Union[None, bool, ProfileConfig],
+                    ) -> Optional[ProfileConfig]:
+    """Normalize the ``profile=`` argument (the ``TraceConfig.resolve``
+    convention).  ``None`` consults ``WF_PROFILE`` (``''``/``'0'`` = off);
+    ``False`` forces off; ``True`` = defaults; a config passes through.
+    ``WF_PROFILE_WINDOW_MS`` / ``WF_PROFILE_MAX_CAPTURES`` override either
+    way.  Returns None when profiling is off."""
+    if profile is False:
+        return None
+    if isinstance(profile, ProfileConfig):
+        cfg = profile
+    elif profile is True:
+        cfg = ProfileConfig()
+    else:                                  # None: env-driven
+        env = os.environ.get("WF_PROFILE", "")
+        if env in ("", "0"):
+            return None
+        cfg = ProfileConfig()
+    win = os.environ.get("WF_PROFILE_WINDOW_MS", "")
+    if win:
+        cfg = dataclasses.replace(cfg, window_ms=float(win))
+    mx = os.environ.get("WF_PROFILE_MAX_CAPTURES", "")
+    if mx:
+        cfg = dataclasses.replace(cfg, max_captures=int(mx))
+    return cfg
+
+
+def profile_problems(cfg: Optional[ProfileConfig],
+                     slo_on: bool,
+                     interval_s: Optional[float]) -> List[str]:
+    """The WF120 check surface (shared by ``MonitoringConfig`` construction
+    and ``analysis/validate.py``): problems with a resolved profile config
+    against the monitoring setup it rides.  Empty when ``cfg`` is None."""
+    if cfg is None:
+        return []
+    probs: List[str] = []
+    if not slo_on:
+        probs.append(
+            "profile-on-page is on but the SLO engine is off — captures "
+            "trigger from PAGE entry only, so WF_PROFILE without WF_SLO "
+            "(monitoring + at least one SLOSpec) can never fire")
+    if interval_s is not None and float(cfg.window_ms) / 1e3 >= float(
+            interval_s):
+        probs.append(
+            f"profile window {cfg.window_ms} ms >= reporter interval "
+            f"{float(interval_s) * 1e3:g} ms — the capture runs ON the "
+            f"Reporter tick thread, so a window that reaches the interval "
+            f"stacks ticks; shrink WF_PROFILE_WINDOW_MS or stretch the "
+            f"monitoring interval")
+    try:
+        import jax  # noqa: F401 — availability probe only
+    except Exception as e:  # noqa: BLE001 — any import failure means no jax
+        probs.append(
+            f"profile-on-page is on but jax is not importable on this box "
+            f"({type(e).__name__}: {e}) — every capture would be skipped; "
+            f"unset WF_PROFILE where the serving host has no device "
+            f"runtime")
+    return probs
+
+
+def profile_window(logdir: str,
+                   window_ms: float = DEFAULT_WINDOW_MS) -> dict:
+    """One bounded profiler capture: open the ONE ``stats.xprof_trace``
+    session, hold it for ``window_ms`` of wall time while the device keeps
+    executing whatever the drive loop has in flight, close it, and return
+    a summary (``logdir``, ``window_ms``, the files written with sizes).
+
+    Raises the guard's RuntimeError (naming the holder) when a session is
+    already active — the programmatic caller decides; the incident path
+    (:class:`ProfileOnPage`) converts it into a ``profile_skipped``
+    record."""
+    from ..stats import xprof_trace  # lazy: jax-bearing module
+    window_s = float(window_ms) / 1e3
+    t0 = time.perf_counter()  # wf-lint: allow[wall-clock] timing-only: capture window bound
+    with xprof_trace(logdir):
+        # the window IS a sleep: the profiler samples the device/runtime
+        # threads, the capture thread only bounds the session
+        while True:
+            left = window_s - (time.perf_counter() - t0)  # wf-lint: allow[wall-clock] timing-only: capture window bound
+            if left <= 0:
+                break
+            time.sleep(min(left, 0.01))
+    files = []
+    for root, _dirs, names in os.walk(logdir):
+        for nm in sorted(names):
+            p = os.path.join(root, nm)
+            try:
+                files.append({"name": os.path.relpath(p, logdir),
+                              "bytes": os.path.getsize(p)})
+            except OSError:
+                continue
+    return {"logdir": logdir, "window_ms": float(window_ms),
+            "files": sorted(files, key=lambda f: f["name"])}
+
+
+class ProfileOnPage:
+    """The ``SLOEngine.profiler`` callable: ``fn(out_dir) -> dict`` run at
+    incident-capture time, BEFORE the manifest commits.  Returns either a
+    :func:`profile_window` summary or ``{"profile_skipped": reason}`` —
+    never raises (forensics must not kill a Reporter tick), and never
+    latches anything itself (the one-session-guard satellite: a held
+    ``xprof_trace`` is a skip reason, not a second latch)."""
+
+    def __init__(self, config: Optional[ProfileConfig] = None):
+        self.config = config or ProfileConfig()
+        #: capture attempts so far — single-writer: the Reporter tick
+        #: thread is the only caller (SLOEngine.observe -> capture)
+        self.captures = 0                 # wf-lint: single-writer[reporter]
+
+    def __call__(self, out_dir: str) -> dict:
+        if self.captures >= int(self.config.max_captures):
+            return {"profile_skipped":
+                    f"max captures reached "
+                    f"({int(self.config.max_captures)} per run)"}
+        self.captures += 1
+        try:
+            import jax  # noqa: F401 — availability probe only
+        except Exception as e:  # noqa: BLE001 — no jax: record why, move on
+            return {"profile_skipped":
+                    f"jax unavailable ({type(e).__name__}: {e})"}
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            return profile_window(out_dir, self.config.window_ms)
+        except RuntimeError as e:
+            # the session guard (another capture holds the one profiler
+            # session) or a backend that cannot profile — both are skip
+            # reasons inside a bundle, never a failed tick
+            return {"profile_skipped": f"{type(e).__name__}: {e}"}
+        except OSError as e:
+            return {"profile_skipped": f"OSError: {e}"}
+
+
+def load_profile(bundle_dir: str) -> Optional[dict]:
+    """``profile.json`` of one incident bundle (or None) — the
+    ``wf_profile.py`` reader; stdlib only."""
+    import json
+    path = os.path.join(bundle_dir, "profile.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

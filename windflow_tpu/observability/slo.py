@@ -245,6 +245,25 @@ def _sig_tenant_shed_tuples(snap, prev, tenant: str) -> Optional[float]:
                - float(prow.get("shed_tuples", 0)), 0.0)
 
 
+def _sig_tenant_e2e_p99_ms(snap, prev, tenant: str) -> Optional[float]:
+    """One tenant's wire-to-sink p99 over the LAST TICK's samples
+    (``serving.tenants`` ``e2e_p99_tick_ms`` — the windowed form, the
+    ``_sig_e2e_p99_ms`` discipline: a cumulative p99 could never recover
+    below target once a stall pushed the whole-run percentile over it).
+    None when the tenant sent no traffic this tick (or latency sampling is
+    off), which neither violates nor clears — the burn windows hold."""
+    row = _tenant_row(snap, tenant)
+    if row is None:
+        return None
+    if "e2e_samples_tick" in row:
+        if not row["e2e_samples_tick"]:
+            return None                  # no traffic from this tenant
+        return float(row.get("e2e_p99_tick_ms", 0.0))
+    if not row.get("e2e_samples"):
+        return None                      # latency never sampled
+    return float(row.get("e2e_p99_ms", 0.0))
+
+
 #: tenant-labelled signal family (the serving plane's label dimension):
 #: name -> (extractor(snap, prev, tenant), default mode).  A spec using one
 #: of these MUST carry ``tenant=`` (and a host signal must NOT) — enforced
@@ -253,6 +272,7 @@ def _sig_tenant_shed_tuples(snap, prev, tenant: str) -> Optional[float]:
 TENANT_SIGNALS: Dict[str, Tuple[Callable, str]] = {
     "tenant_drop_ratio": (_sig_tenant_drop_ratio, "max"),
     "tenant_shed_tuples": (_sig_tenant_shed_tuples, "max"),
+    "tenant_e2e_p99_ms": (_sig_tenant_e2e_p99_ms, "max"),
 }
 
 
@@ -514,6 +534,14 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
         #: capture asks it for ``section()`` to commit ``remediation.json``
         #: into every bundle before the manifest
         self.remediation = None
+        #: profile-on-page hook (or None): ``fn(dir) -> dict`` run at
+        #: capture time (``observability/profiling.py`` ProfileOnPage) —
+        #: the returned summary (a capture manifest or a recorded
+        #: ``profile_skipped`` reason) commits as ``profile.json`` BEFORE
+        #: the bundle manifest, with the raw capture under ``<bundle>/
+        #: profile/``.  Same verdict_hook wiring convention (Monitor binds
+        #: it); same thread (Reporter tick); must never raise
+        self.profiler = None
         self._incoming_slo = None
 
     # -- evaluation --------------------------------------------------------
@@ -696,6 +724,17 @@ class SLOEngine:  # wf-lint: single-writer[reporter, driver]
             put("remediation.json", self.remediation.section())
         for fname, data in sorted(self._extra_bundle_files(st, snap).items()):
             put(fname, data)
+        if self.profiler is not None:
+            # profile-on-page: the bounded device capture (or its recorded
+            # skip reason) commits BEFORE the manifest, so a committed
+            # bundle either carries on-device evidence or says why not
+            try:
+                prof = self.profiler(os.path.join(d, "profile"))
+            except Exception as e:  # noqa: BLE001 — forensics must never
+                # kill the tick; ProfileOnPage already catches, this is the
+                # belt for a user-supplied hook
+                prof = {"profile_skipped": f"{type(e).__name__}: {e}"}
+            put("profile.json", prof)
         # manifest LAST — the commit point
         _atomic_write(os.path.join(d, "manifest.json"), json.dumps({
             "schema": 1, "slo": st.spec.name, "signal": st.spec.signal,

@@ -313,9 +313,17 @@ class Tracer:
                 self._segments.append(seg)
         return seg
 
-    def ingest(self, batch, pos: int, stream: int = 0) -> Optional[int]:
+    def ingest(self, batch, pos: int, stream: int = 0,
+               extras: Optional[dict] = None) -> Optional[int]:
         """Source boundary: sample + mint + attach + record.  Returns the
-        minted id (None when the batch fell outside the sample)."""
+        minted id (None when the batch fell outside the sample).
+
+        ``extras`` rides the ingest record verbatim (flattened into the
+        flight.jsonl row by :meth:`records`) — the serving runtime joins
+        the wire coordinates here: ``tenant``/``seq`` plus ``wire_ms``
+        (client send -> socket receipt) and ``queue_ms`` (receipt -> drive
+        pickup), so the per-tenant trace report can attribute time spent
+        BEFORE the batch existed on this host."""
         if pos % self.sample_every:
             return None
         if self.config.ids == "sequence":
@@ -328,8 +336,10 @@ class Tracer:
         object.__setattr__(batch, TRACE_META_ATTR, tid)
         seg = self._seg()
         seg.minted += 1
-        seg.add((time.perf_counter(), tid, "ingest", K_INGEST,
-                 {"pos": int(pos), "stream": int(stream)}))
+        extra = {"pos": int(pos), "stream": int(stream)}
+        if extras:
+            extra.update(extras)
+        seg.add((time.perf_counter(), tid, "ingest", K_INGEST, extra))
         return tid
 
     def event(self, batch, stage: str, kind: str) -> None:
@@ -439,10 +449,11 @@ def get_active() -> Optional[Tracer]:
     return _active
 
 
-def ingest(batch, pos: int, stream: int = 0) -> None:
+def ingest(batch, pos: int, stream: int = 0,
+           extras: Optional[dict] = None) -> None:
     tr = _active
     if tr is not None:
-        tr.ingest(batch, pos, stream)
+        tr.ingest(batch, pos, stream, extras=extras)
 
 
 def event(batch, stage: str, kind: str) -> None:
@@ -658,7 +669,11 @@ def _batch_lifecycles(records: List[dict]) -> Dict[int, dict]:
             lc = out[tid] = {"tid": tid, "pos": None, "stream": None,
                              "t_ingest": None, "t_end": None,
                              "service": {}, "queue": {}, "aborts": 0,
-                             "attempts": {}, "fused": 0}
+                             "attempts": {}, "fused": 0,
+                             # wire-to-sink coordinates (serving ingest
+                             # extras; None for non-serving drivers)
+                             "tenant": None, "seq": None,
+                             "wire_ms": None, "queue_ms": None}
         return lc
 
     open_begin: Dict[tuple, tuple] = {}    # (tid, stage) -> (t, k or None)
@@ -674,6 +689,10 @@ def _batch_lifecycles(records: List[dict]) -> Dict[int, dict]:
                 lc["t_ingest"] = t
                 lc["pos"] = r.get("pos")
                 lc["stream"] = r.get("stream")
+                lc["tenant"] = r.get("tenant")
+                lc["seq"] = r.get("seq")
+                lc["wire_ms"] = r.get("wire_ms")
+                lc["queue_ms"] = r.get("queue_ms")
         elif kind == K_BEGIN:
             open_begin[(tid, stage)] = (t, r.get("k"))
             lc["attempts"][stage] = lc["attempts"].get(stage, 0) + 1
@@ -818,6 +837,48 @@ def critical_path_report(records: List[dict],
             lines.append(f"  {e.get('op', '?'):<24} {e.get('kind', '?'):<16} "
                          f"+{e.get('n', 0)} (total {e.get('total', '?')})"
                          f"{where}")
+
+    # -- per-tenant wire-to-sink attribution (serving) --------------------
+    by_tenant: Dict[str, list] = {}
+    for lc in lives.values():
+        if lc.get("tenant") is not None:
+            by_tenant.setdefault(str(lc["tenant"]), []).append(lc)
+    if by_tenant:
+        lines.append("")
+        lines.append("per-tenant wire-to-sink attribution (serving ingest; "
+                     "wire = client send -> socket receipt, queue = receipt "
+                     "-> drive pickup + ring waits, service = stage spans):")
+
+        def _segments(lc) -> dict:
+            wire = (lc.get("wire_ms") or 0.0) / 1e3
+            qsrc = (lc.get("queue_ms") or 0.0) / 1e3
+            svc = sum(lc["service"].values())
+            qring = sum(lc["queue"].values())
+            t0, t1 = lc["t_ingest"], lc["t_end"]
+            host = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
+            return {"wire": wire, "queue": qsrc + qring, "service": svc,
+                    "e2e": wire + qsrc + host}
+
+        for tenant, lcs in sorted(by_tenant.items()):
+            segs = [_segments(lc) for lc in lcs]
+            shed_n = sum(1 for lc in lcs if _is_shed(lc))
+            head = f"  tenant {tenant!r}: {len(lcs)} traced requests"
+            if shed_n:
+                head += f"  ({shed_n} shed at admission)"
+            lines.append(head)
+            worst_seg, worst_max = "", -1.0
+            for name in ("wire", "queue", "service", "e2e"):
+                vals = [s[name] for s in segs]
+                avg, mx = sum(vals) / len(vals), max(vals)
+                lines.append(f"    {name:<8} avg={avg * 1e3:10.3f} ms  "
+                             f"max={mx * 1e3:10.3f} ms")
+                if name != "e2e" and mx > worst_max:
+                    worst_seg, worst_max = name, mx
+            slowest = max(zip(segs, lcs), key=lambda p: p[0]["e2e"])
+            lines.append(f"    slowest segment: {worst_seg}  "
+                         f"(worst request: batch {slowest[1]['tid']:#x} "
+                         f"seq={slowest[1].get('seq')} "
+                         f"e2e={slowest[0]['e2e'] * 1e3:.3f} ms)")
 
     # -- dispatch-bound classifier (health monitoring) --------------------
     health = (snapshot or {}).get("health") or {}

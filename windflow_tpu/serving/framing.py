@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import socket
+import time
 from typing import Dict, List, Optional, Tuple
 
 #: frame magic — the resync point for readers that land mid-stream
@@ -57,9 +58,15 @@ DEFAULT_TENANT = "default"
 
 def encode_record_frame(records: bytes = b"", *, tenant: str = DEFAULT_TENANT,
                         seq: int = 0, kind: str = KIND_DATA,
-                        graph: Optional[str] = None) -> bytes:
+                        graph: Optional[str] = None,
+                        t_send: Optional[float] = None,
+                        span: Optional[str] = None) -> bytes:
     """One length-framed record frame (see the module docstring's grammar).
-    ``graph`` names the swap target on ``kind="swap"`` frames."""
+    ``graph`` names the swap target on ``kind="swap"`` frames.  ``t_send``
+    (sender wall time) and ``span`` (a client-chosen span id) are OPTIONAL
+    meta keys — the wire-to-sink tracing stamp; decoders that predate them
+    pass unknown meta keys through untouched (the forward-compat pin in
+    ``tests/test_serving.py``), so stamped frames need no flag day."""
     if kind not in FRAME_KINDS:
         raise ValueError(f"unknown frame kind {kind!r} "
                          f"(kinds: {', '.join(FRAME_KINDS)})")
@@ -67,6 +74,10 @@ def encode_record_frame(records: bytes = b"", *, tenant: str = DEFAULT_TENANT,
             "nbytes": len(records)}
     if graph is not None:
         meta["graph"] = str(graph)
+    if t_send is not None:
+        meta["t_send"] = round(float(t_send), 6)
+    if span is not None:
+        meta["span"] = str(span)
     head = json.dumps(meta, sort_keys=True).encode("utf-8") + b"\n"
     payload = head + bytes(records)
     if len(payload) > MAX_FRAME_BYTES:
@@ -215,9 +226,17 @@ class RecordClient:
     seqs are deduped server-side, so replay is idempotent (the tentpole's
     peer-kill contract)."""
 
-    def __init__(self, endpoint: str, timeout: float = 5.0):
+    def __init__(self, endpoint: str, timeout: float = 5.0,
+                 stamp: bool = True):
         self.endpoint = endpoint
         self.timeout = timeout
+        #: wire-to-sink tracing stamp: when on (default), every data frame's
+        #: meta carries ``t_send`` (sender wall time) + a deterministic
+        #: client ``span`` id (``tenant/seq``) — old servers ignore both
+        #: (unknown-meta-key forward compat), so the stamp has no flag day.
+        #: ``stamp=False`` reproduces pre-stamp clients exactly (the
+        #: backward-compat regression path).
+        self.stamp = bool(stamp)
         self._seq: Dict[str, int] = {}
         self._sock: Optional[socket.socket] = None
 
@@ -233,8 +252,12 @@ class RecordClient:
         if seq is None:
             seq = self._seq.get(tenant, -1) + 1
         self._seq[tenant] = max(self._seq.get(tenant, -1), seq)
+        kw = {}
+        if self.stamp:
+            kw = {"t_send": time.time(),  # wf-lint: allow[wall-clock] cross-process wire timing needs wall time
+                  "span": f"{tenant}/{seq}"}
         self._ensure().sendall(
-            encode_record_frame(records, tenant=tenant, seq=seq))
+            encode_record_frame(records, tenant=tenant, seq=seq, **kw))
         return seq
 
     def send_eos(self, tenant: str = DEFAULT_TENANT) -> None:

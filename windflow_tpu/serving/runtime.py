@@ -271,7 +271,7 @@ class ServingRuntime:
             n = 0
             n_offered = 0
 
-            def drive(b):
+            def drive(b, tenant=DEFAULT_TENANT, wire_s=0.0):
                 nonlocal n
                 sampled = (mon is not None and self.sink is not None
                            and mon.config.should_sample_e2e(n))
@@ -287,23 +287,52 @@ class ServingRuntime:
                     if sspan is not None:
                         sspan.done()
                 if sampled:
-                    mon.registry.record_e2e(time.perf_counter() - t0,  # wf-lint: allow[wall-clock] timing-only: e2e sample
-                                            exemplar=_tracing.tid_of(b))
+                    dt = time.perf_counter() - t0  # wf-lint: allow[wall-clock] timing-only: e2e sample
+                    ex = _tracing.tid_of(b)
+                    mon.registry.record_e2e(dt, exemplar=ex)
+                    if self.registry is not None:
+                        # wire-to-sink per-tenant latency: the host service
+                        # time plus the wire+source-queue segments measured
+                        # at ingest (0 for unstamped/old clients) — feeds
+                        # serving.tenants e2e_* and tenant_e2e_p99_ms
+                        mon.registry.record_tenant_e2e(
+                            tenant, dt + wire_s, exemplar=ex)
                 n += 1
 
             # un-prefetched by design: last_tenant attribution requires
             # the drive thread to pull batches synchronously (sources.py)
             for batch in self.source.batches(self.batch_size):
                 record_source_launch(self.source, batch)
-                _tracing.ingest(batch, n_offered)
-                self._consume_swaps()
                 tenant = getattr(self.source, "last_tenant", DEFAULT_TENANT)
+                wire = getattr(self.source, "last_wire", None)
+                wire_s, extras = 0.0, None
+                if wire is not None:
+                    # wall clocks by design: t_send is the CLIENT's clock,
+                    # t_recv this host's — a perf_counter pair could never
+                    # cross the process boundary
+                    t_recv = wire.get("t_recv")
+                    t_send = wire.get("t_send")
+                    extras = {"tenant": tenant, "seq": wire.get("seq")}
+                    if t_recv is not None:
+                        q_ms = max(time.time() - t_recv, 0.0) * 1e3  # wf-lint: allow[wall-clock] cross-process wire timing needs wall time
+                        extras["queue_ms"] = round(q_ms, 3)
+                        wire_s += q_ms / 1e3
+                        if t_send is not None:
+                            w_ms = max(t_recv - t_send, 0.0) * 1e3
+                            extras["wire_ms"] = round(w_ms, 3)
+                            wire_s += w_ms / 1e3
+                    if wire.get("span") is not None:
+                        extras["span"] = wire["span"]
+                elif self.registry is not None:
+                    extras = {"tenant": tenant}
+                _tracing.ingest(batch, n_offered, extras=extras)
+                self._consume_swaps()
                 admitted = ([batch] if self.registry is None
                             else self.registry.offer(tenant, batch,
                                                      pos=n_offered))
                 n_offered += 1
                 for ab in admitted:
-                    drive(ab)
+                    drive(ab, tenant, wire_s)
             _journal.record("eos", pipeline=self.name)
             self._consume_swaps()
             if self.registry is not None:

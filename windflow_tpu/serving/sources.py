@@ -98,6 +98,16 @@ class SocketSource(RecordSource):
         #: valid only under the single-threaded, un-prefetched drive
         #: contract (module docstring)
         self.last_tenant = framing.DEFAULT_TENANT
+        #: wire timing of the most recently yielded chunk (same contract):
+        #: ``{"seq", "t_send", "t_recv", "span"}`` — ``t_send``/``span``
+        #: are None for frames from pre-stamp clients (meta keys absent),
+        #: ``t_recv`` is this host's receipt wall time.  The drive loop
+        #: turns the pair into wire/queue segment attribution for the
+        #: per-tenant trace report.
+        self.last_wire: Optional[dict] = None
+        #: chunk idx -> wire timing, popped as chunks are yielded; bounded
+        #: so a stalled drive loop can't grow it without bound
+        self._wire: Dict[int, dict] = {}         # wf-lint: guarded-by[_lock]
 
     # -- lifecycle ------------------------------------------------------
 
@@ -222,6 +232,23 @@ class SocketSource(RecordSource):
             rec = np.frombuffer(blob, dtype=self.dtype).copy()
             idx = self._next_chunk
             self._next_chunk += 1
+            # wire receipt stamp: t_send/span ride the frame meta when the
+            # client stamped them (framing.RecordClient); both are
+            # attacker-supplied, so coercion failure degrades to "no stamp"
+            # — never an exception out of the ingest thread
+            t_send = meta.get("t_send")
+            if t_send is not None:
+                try:
+                    t_send = float(t_send)
+                except (TypeError, ValueError):
+                    t_send = None
+            span = meta.get("span")
+            self._wire[idx] = {
+                "seq": seq, "t_send": t_send,
+                "t_recv": time.time(),  # wf-lint: allow[wall-clock] cross-process wire timing needs wall time
+                "span": None if span is None else str(span)}
+            while len(self._wire) > 4 * self.replay:
+                self._wire.pop(next(iter(self._wire)))
             self._ring.append((idx, tenant, rec))
             # the put MUST stay inside the lock: with concurrent clients,
             # enqueueing outside would let a later idx land first and the
@@ -265,6 +292,8 @@ class SocketSource(RecordSource):
             # replayed chunks were already dequeued by the pre-restart
             # incarnation; re-drive them from the ring in idx order
             self.last_tenant = tenant
+            with self._lock:
+                self.last_wire = self._wire.pop(idx, None)
             pos = idx + 1
             yield rec
         while True:
@@ -279,6 +308,8 @@ class SocketSource(RecordSource):
             if idx < pos:
                 continue
             self.last_tenant = tenant
+            with self._lock:
+                self.last_wire = self._wire.pop(idx, None)
             pos = idx + 1
             yield rec
 
